@@ -1,0 +1,4 @@
+from poseidon_tpu.graph.network import FlowNetwork, pad_bucket
+from poseidon_tpu.graph.builder import FlowGraphBuilder, NodeRole, ArcKind
+
+__all__ = ["FlowNetwork", "pad_bucket", "FlowGraphBuilder", "NodeRole", "ArcKind"]

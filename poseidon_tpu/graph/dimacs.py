@@ -1,0 +1,103 @@
+"""DIMACS min-cost-flow text format read/write.
+
+DIMACS is the lingua franca of the reference's solver seam: Firmament
+serializes the flow network to its external solver binary (cs2/Flowlessly,
+reference deploy/poseidon.cfg:8-10, README.md:21) in this format. We keep
+it as the interchange with our C++ CPU oracle (poseidon_tpu/oracle/) and
+for golden-instance fixtures.
+
+Format (1-indexed nodes):
+    c <comment>
+    p min <n_nodes> <n_arcs>
+    n <node_id> <supply>          (only nonzero supplies listed)
+    a <src> <dst> <low> <cap> <cost>
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from poseidon_tpu.graph.network import FlowNetwork
+
+
+def write_dimacs(net: FlowNetwork) -> str:
+    h = net.to_host()
+    n_nodes = int(net.n_nodes)
+    n_arcs = int(net.n_arcs)
+    out = io.StringIO()
+    out.write(f"p min {n_nodes} {n_arcs}\n")
+    supply = h["supply"]
+    for v in np.flatnonzero(supply):
+        out.write(f"n {v + 1} {int(supply[v])}\n")
+    src, dst, cap, cost = h["src"], h["dst"], h["cap"], h["cost"]
+    for a in range(n_arcs):
+        out.write(
+            f"a {int(src[a]) + 1} {int(dst[a]) + 1} 0 "
+            f"{int(cap[a])} {int(cost[a])}\n"
+        )
+    return out.getvalue()
+
+
+def read_dimacs(text: str) -> FlowNetwork:
+    n_nodes = n_arcs = -1
+    supply: np.ndarray | None = None
+    src: list[int] = []
+    dst: list[int] = []
+    cap: list[int] = []
+    cost: list[int] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("c"):
+            continue
+        parts = line.split()
+        if parts[0] == "p":
+            if parts[1] != "min":
+                raise ValueError(f"not a min-cost problem line: {line!r}")
+            n_nodes, n_arcs = int(parts[2]), int(parts[3])
+            supply = np.zeros(n_nodes, dtype=np.int64)
+        elif parts[0] == "n":
+            if supply is None:
+                raise ValueError("n line before p line")
+            supply[int(parts[1]) - 1] = int(parts[2])
+        elif parts[0] == "a":
+            if int(parts[3]) != 0:
+                raise ValueError("nonzero lower bounds unsupported")
+            src.append(int(parts[1]) - 1)
+            dst.append(int(parts[2]) - 1)
+            cap.append(int(parts[4]))
+            cost.append(int(parts[5]))
+    if supply is None:
+        raise ValueError("missing p line")
+    if len(src) != n_arcs:
+        raise ValueError(f"expected {n_arcs} arcs, got {len(src)}")
+    return FlowNetwork.from_arrays(src, dst, cap, cost, supply)
+
+
+def parse_flow_output(text: str, n_arcs: int) -> tuple[int, np.ndarray]:
+    """Parse DIMACS solution lines: ``s <cost>`` + ``f <src> <dst> <flow>``.
+
+    Our C++ oracle prints exactly one ``f`` line per input arc, in input
+    order (including zero flows), so the k-th ``f`` line is the flow on
+    arc k. Returns (total_cost, int64[n_arcs] flows).
+    """
+    total: int | None = None
+    flows = np.zeros(n_arcs, dtype=np.int64)
+    k = 0
+    for raw in text.splitlines():
+        parts = raw.split()
+        if not parts:
+            continue
+        if parts[0] == "s":
+            total = int(parts[1])
+        elif parts[0] == "f":
+            if k >= n_arcs:
+                raise ValueError("more f lines than arcs")
+            flows[k] = int(parts[3])
+            k += 1
+    if total is None:
+        raise ValueError("no 's' (solution cost) line in solver output")
+    if k not in (0, n_arcs):
+        raise ValueError(f"expected 0 or {n_arcs} f lines, got {k}")
+    return total, flows

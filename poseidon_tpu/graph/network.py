@@ -1,0 +1,145 @@
+"""L0 flow-network data model: structure-of-arrays, padded, device-resident.
+
+The reference keeps its flow network inside Firmament's C++
+``FlowGraphManager`` (surface visible at reference
+src/firmament/scheduler_bridge.cc:37-42 and deploy/poseidon.cfg:12-19); the
+solver then re-serializes it to DIMACS text for a child process. Here the
+network *is* the device representation: int32 arc/node tables padded to
+power-of-two buckets so jit recompilation is rare as the cluster churns.
+
+Conventions
+-----------
+* Arcs are directed ``src -> dst`` with integer capacity ``cap >= 0`` and
+  integer unit cost ``cost``. Lower bounds are always 0 (the reference's
+  DIMACS usage never needs nonzero lower bounds).
+* ``supply[v] > 0`` means v is a source of that many flow units, ``< 0`` a
+  demand. Supplies sum to 0 over real nodes.
+* Padding: arc slots with index >= n_arcs have cap == 0, cost == 0 and
+  src == dst == 0, so every vectorized sweep treats them as harmless no-ops.
+  Node slots >= n_nodes have supply == 0.
+* All solver arithmetic is int32 and exact — optimality is checked against
+  the C++ oracle, not approximated. Cost magnitudes must satisfy
+  ``max|cost| * n_nodes * ALPHA < 2**31`` (checked in the solvers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+
+def pad_bucket(n: int, minimum: int = 16) -> int:
+    """Next power-of-two bucket >= max(n, minimum).
+
+    Grow-only bucketing bounds the number of distinct compiled shapes to
+    O(log n) as the cluster scales (SURVEY.md section 5.7).
+    """
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FlowNetwork:
+    """A padded min-cost-flow instance as device arrays (a JAX pytree).
+
+    Shapes: arcs padded to E slots, nodes padded to N slots. ``n_nodes`` /
+    ``n_arcs`` are traced int32 scalars carrying the real counts, so one
+    compiled solver serves every instance within a (N, E) bucket.
+    """
+
+    src: jax.Array      # int32[E] arc tail
+    dst: jax.Array      # int32[E] arc head
+    cap: jax.Array      # int32[E] capacity (0 on padding)
+    cost: jax.Array     # int32[E] unit cost (0 on padding)
+    supply: jax.Array   # int32[N] node supply (+source / -demand, 0 padding)
+    n_nodes: jax.Array  # int32 scalar, real node count
+    n_arcs: jax.Array   # int32 scalar, real arc count
+
+    @property
+    def num_node_slots(self) -> int:
+        return self.supply.shape[-1]
+
+    @property
+    def num_arc_slots(self) -> int:
+        return self.src.shape[-1]
+
+    @staticmethod
+    def from_arrays(
+        src: Any,
+        dst: Any,
+        cap: Any,
+        cost: Any,
+        supply: Any,
+        *,
+        node_slots: int | None = None,
+        arc_slots: int | None = None,
+        validate: bool = True,
+    ) -> "FlowNetwork":
+        """Build a padded instance from host arrays (any integer dtype)."""
+        src = np.asarray(src, dtype=np.int32)
+        dst = np.asarray(dst, dtype=np.int32)
+        cap = np.asarray(cap, dtype=np.int32)
+        cost = np.asarray(cost, dtype=np.int32)
+        supply = np.asarray(supply, dtype=np.int32)
+        n_arcs = src.shape[0]
+        n_nodes = supply.shape[0]
+        if validate:
+            if not (dst.shape[0] == cap.shape[0] == cost.shape[0] == n_arcs):
+                raise ValueError("arc arrays disagree on length")
+            if n_arcs and (src.min() < 0 or src.max() >= n_nodes):
+                raise ValueError("arc src out of range")
+            if n_arcs and (dst.min() < 0 or dst.max() >= n_nodes):
+                raise ValueError("arc dst out of range")
+            if n_arcs and cap.min() < 0:
+                raise ValueError("negative capacity")
+            if int(supply.sum()) != 0:
+                raise ValueError(f"supplies must sum to 0, got {supply.sum()}")
+        N = node_slots or pad_bucket(n_nodes)
+        E = arc_slots or pad_bucket(n_arcs)
+        if N < n_nodes or E < n_arcs:
+            raise ValueError("padding slots smaller than real counts")
+
+        def pad(a: np.ndarray, size: int) -> np.ndarray:
+            out = np.zeros(size, dtype=np.int32)
+            out[: a.shape[0]] = a
+            return out
+
+        return FlowNetwork(
+            src=jnp.asarray(pad(src, E)),
+            dst=jnp.asarray(pad(dst, E)),
+            cap=jnp.asarray(pad(cap, E)),
+            cost=jnp.asarray(pad(cost, E)),
+            supply=jnp.asarray(pad(supply, N)),
+            n_nodes=jnp.int32(n_nodes),
+            n_arcs=jnp.int32(n_arcs),
+        )
+
+    def with_costs(self, cost: jax.Array) -> "FlowNetwork":
+        """Same topology, new arc costs (cost-model recompute path)."""
+        return dataclasses.replace(self, cost=cost.astype(jnp.int32))
+
+    # ---- host-side conveniences (not for use inside jit) ----
+
+    def to_host(self) -> dict[str, np.ndarray]:
+        na = int(self.n_arcs)
+        nn = int(self.n_nodes)
+        return {
+            "src": np.asarray(self.src)[:na],
+            "dst": np.asarray(self.dst)[:na],
+            "cap": np.asarray(self.cap)[:na],
+            "cost": np.asarray(self.cost)[:na],
+            "supply": np.asarray(self.supply)[:nn],
+        }
+
+
+def total_supply(net: FlowNetwork) -> int:
+    """Total positive supply (the flow value a feasible solution must route)."""
+    s = np.asarray(net.supply)
+    return int(s[s > 0].sum())

@@ -1,0 +1,31 @@
+"""Test harness config: force an 8-device CPU JAX platform.
+
+Sharded-solver tests exercise real multi-device code paths without TPU
+hardware (SURVEY.md section 4: "multi-node without a real cluster").
+
+Note: setting the JAX_PLATFORMS env var is NOT enough in environments
+where a sitecustomize hook registers a TPU plugin and re-pins
+``jax_platforms`` via ``jax.config.update`` at interpreter start — we must
+update the config again here, before any backend is initialized.
+"""
+
+import os
+import re
+
+_flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+",
+    "",
+    os.environ.get("XLA_FLAGS", ""),
+)
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.device_count() == 8, (
+    f"expected 8 forced CPU devices, got {jax.device_count()} "
+    f"{jax.devices()[0].platform}"
+)

@@ -1,0 +1,128 @@
+"""L0 tests: FlowNetwork invariants, builder taxonomy, DIMACS round-trip."""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.cluster import Machine, Task, make_cluster
+from poseidon_tpu.graph.builder import ArcKind, FlowGraphBuilder, NodeRole
+from poseidon_tpu.graph.dimacs import read_dimacs, write_dimacs
+from poseidon_tpu.graph.network import FlowNetwork, pad_bucket, total_supply
+
+
+def small_cluster(n_machines=3, n_tasks=5, racks=2):
+    machines = [
+        Machine(name=f"m{i}", rack=f"r{i % racks}", max_tasks=4)
+        for i in range(n_machines)
+    ]
+    tasks = [
+        Task(uid=f"p{i}", job=f"j{i % 2}",
+             data_prefs={"m0": 100} if i == 0 else {})
+        for i in range(n_tasks)
+    ]
+    return make_cluster(machines, tasks)
+
+
+class TestPadBucket:
+    def test_powers(self):
+        assert pad_bucket(1) == 16
+        assert pad_bucket(16) == 16
+        assert pad_bucket(17) == 32
+        assert pad_bucket(1000) == 1024
+
+    def test_minimum(self):
+        assert pad_bucket(3, minimum=4) == 4
+
+
+class TestFlowNetwork:
+    def test_padding_and_counts(self):
+        net = FlowNetwork.from_arrays(
+            src=[0, 1], dst=[1, 2], cap=[5, 5], cost=[1, -2],
+            supply=[5, 0, -5],
+        )
+        assert net.num_arc_slots == 16
+        assert net.num_node_slots == 16
+        assert int(net.n_arcs) == 2
+        assert int(net.n_nodes) == 3
+        # padding slots are no-ops
+        assert int(np.asarray(net.cap)[2:].sum()) == 0
+        assert int(np.asarray(net.supply)[3:].sum()) == 0
+        assert total_supply(net) == 5
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="sum to 0"):
+            FlowNetwork.from_arrays([0], [1], [1], [0], [1, 0])
+        with pytest.raises(ValueError, match="out of range"):
+            FlowNetwork.from_arrays([0], [9], [1], [0], [1, -1])
+        with pytest.raises(ValueError, match="negative capacity"):
+            FlowNetwork.from_arrays([0], [1], [-1], [0], [1, -1])
+
+    def test_pytree(self):
+        import jax
+
+        net = FlowNetwork.from_arrays([0], [1], [1], [3], [1, -1])
+        leaves = jax.tree_util.tree_leaves(net)
+        assert len(leaves) == 7
+
+
+class TestBuilder:
+    def test_taxonomy(self):
+        net, meta = FlowGraphBuilder().build(small_cluster())
+        roles = meta.node_role
+        assert roles[0] == NodeRole.SINK
+        assert roles[1] == NodeRole.CLUSTER_AGG
+        assert (roles == NodeRole.MACHINE).sum() == 3
+        assert (roles == NodeRole.TASK).sum() == 5
+        assert (roles == NodeRole.UNSCHED).sum() == 2  # two jobs
+        assert (roles == NodeRole.RACK).sum() == 2
+
+    def test_supplies_balance(self):
+        net, meta = FlowGraphBuilder().build(small_cluster())
+        supply = np.asarray(net.supply)
+        assert supply.sum() == 0
+        assert supply[np.asarray(meta.task_node)].tolist() == [1] * 5
+        assert supply[0] == -5
+
+    def test_every_task_has_unsched_arc(self):
+        net, meta = FlowGraphBuilder().build(small_cluster())
+        kinds = meta.arc_kind
+        un = meta.arc_task[kinds == ArcKind.TASK_TO_UNSCHED]
+        assert sorted(un.tolist()) == list(range(5))
+
+    def test_pref_arcs(self):
+        net, meta = FlowGraphBuilder().build(small_cluster())
+        pref = (meta.arc_kind == ArcKind.TASK_TO_MACHINE).sum()
+        assert pref == 1  # only p0 has data_prefs
+        net2, meta2 = FlowGraphBuilder(pref_arcs=False).build(small_cluster())
+        assert (meta2.arc_kind == ArcKind.TASK_TO_MACHINE).sum() == 0
+
+    def test_machine_sink_capacity(self):
+        net, meta = FlowGraphBuilder().build(small_cluster())
+        h = net.to_host()
+        sel = meta.arc_kind == ArcKind.MACHINE_TO_SINK
+        assert h["cap"][sel].tolist() == [4, 4, 4]
+
+    def test_empty_cluster(self):
+        net, meta = FlowGraphBuilder().build(make_cluster())
+        assert meta.n_nodes == 2  # sink + cluster agg
+        assert int(net.n_arcs) == 0
+
+
+class TestDimacs:
+    def test_round_trip(self):
+        net, _ = FlowGraphBuilder().build(small_cluster())
+        # give it some costs so cost survives the trip
+        h = net.to_host()
+        rng = np.random.default_rng(0)
+        net = FlowNetwork.from_arrays(
+            h["src"], h["dst"], h["cap"],
+            rng.integers(-50, 50, size=h["src"].shape[0]),
+            h["supply"],
+        )
+        text = write_dimacs(net)
+        back = read_dimacs(text)
+        for k, v in net.to_host().items():
+            np.testing.assert_array_equal(v, back.to_host()[k], err_msg=k)
+
+    def test_rejects_max_flow_problems(self):
+        with pytest.raises(ValueError, match="min-cost"):
+            read_dimacs("p max 2 1\na 1 2 0 1 0\n")

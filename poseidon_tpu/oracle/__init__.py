@@ -1,0 +1,3 @@
+from poseidon_tpu.oracle.oracle import OracleResult, solve_oracle
+
+__all__ = ["OracleResult", "solve_oracle"]

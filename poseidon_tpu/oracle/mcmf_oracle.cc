@@ -1,0 +1,355 @@
+// mcmf_oracle — CPU min-cost max-flow oracle speaking DIMACS.
+//
+// The native-equivalent of the reference's external solver seam: Poseidon
+// ships Goldberg's cs2 / Flowlessly as separate binaries invoked by
+// Firmament's SolverDispatcher (reference deploy/poseidon.cfg:8-10,
+// deploy/run.sh:7, README.md:21). This binary is (a) the correctness
+// oracle for the TPU solver's differential tests and (b) the CPU baseline
+// for the >=20x benchmark comparison.
+//
+// Algorithms (selectable, mirroring the reference's
+// --flowlessly_algorithm flag, poseidon.cfg:10):
+//   ssp           successive shortest paths (Bellman-Ford potentials init
+//                 when negative costs exist, then Dijkstra + potentials)
+//   cost_scaling  Goldberg-Tarjan cost-scaling push-relabel on the
+//                 min-cost circulation with a -BIG forcing arc
+//                 (cs2-family)
+//
+// Both are exact over int64 arithmetic.
+//
+// I/O contract:
+//   stdin:  DIMACS min ("p min N M", "n id supply", "a src dst 0 cap cost")
+//   stdout: "s <total_cost>" then exactly one "f <src> <dst> <flow>" line
+//           per input arc IN INPUT ORDER (1-indexed endpoints), then
+//           "c time_ms <solve milliseconds>".
+//   exit 1 with "c infeasible" if the supplies cannot be routed.
+//
+// Usage: mcmf_oracle [ssp|cost_scaling] < problem.dimacs
+
+#include <algorithm>
+#include <array>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <limits>
+#include <queue>
+#include <string>
+#include <vector>
+
+namespace {
+
+using i64 = int64_t;
+using i128 = __int128;
+constexpr i64 kInf = std::numeric_limits<i64>::max() / 4;
+
+struct Edge {
+  int to;
+  i64 cap;   // residual capacity
+  i64 cost;  // unit cost
+  int rev;   // index of reverse edge in graph_[to]
+};
+
+struct Solver {
+  int n_ = 0;
+  std::vector<std::vector<Edge>> graph_;
+  // (node, index into graph_[node]) of each *input* arc's forward edge
+  std::vector<std::pair<int, int>> input_arcs_;
+  std::vector<i64> input_cap_;
+
+  void Init(int n) {
+    n_ = n;
+    graph_.assign(n, {});
+  }
+
+  int AddEdge(int from, int to, i64 cap, i64 cost) {
+    // Self-loops put both half-edges in the same list: compute indices
+    // up front so rev-pointers and the returned forward index stay right.
+    int fwd = (int)graph_[from].size();
+    int bwd = (int)graph_[to].size() + (from == to ? 1 : 0);
+    graph_[from].push_back({to, cap, cost, bwd});
+    graph_[to].push_back({from, 0, -cost, fwd});
+    return fwd;
+  }
+
+  void AddInputArc(int from, int to, i64 cap, i64 cost) {
+    int idx = AddEdge(from, to, cap, cost);
+    input_arcs_.emplace_back(from, idx);
+    input_cap_.push_back(cap);
+  }
+
+  i64 MaxAbsCost() const {
+    i64 maxc = 0;
+    for (int v = 0; v < n_; ++v)
+      for (const Edge& e : graph_[v])
+        maxc = std::max(maxc, e.cost < 0 ? -e.cost : e.cost);
+    return maxc;
+  }
+
+  bool HasNegativeCost() const {
+    for (size_t a = 0; a < input_arcs_.size(); ++a) {
+      auto [v, i] = input_arcs_[a];
+      if (graph_[v][i].cost < 0) return true;
+    }
+    return false;
+  }
+
+  // ---- successive shortest paths with potentials ----
+  // Pushes up to `want` units s->t; returns (flow_routed, total_cost).
+  std::pair<i64, i64> SolveSSP(int s, int t, i64 want) {
+    std::vector<i64> pot(n_, 0);
+    if (HasNegativeCost()) BellmanFordPotentials(s, &pot);
+    i64 flow = 0, cost = 0;
+    std::vector<i64> dist(n_);
+    std::vector<int> pv(n_), pe(n_);
+    using QE = std::pair<i64, int>;
+    while (flow < want) {
+      std::priority_queue<QE, std::vector<QE>, std::greater<QE>> pq;
+      std::fill(dist.begin(), dist.end(), kInf);
+      dist[s] = 0;
+      pq.push({0, s});
+      while (!pq.empty()) {
+        auto [d, v] = pq.top();
+        pq.pop();
+        if (d > dist[v]) continue;
+        for (int i = 0; i < (int)graph_[v].size(); ++i) {
+          const Edge& e = graph_[v][i];
+          if (e.cap <= 0) continue;
+          i64 nd = d + e.cost + pot[v] - pot[e.to];
+          if (nd < dist[e.to]) {
+            dist[e.to] = nd;
+            pv[e.to] = v;
+            pe[e.to] = i;
+            pq.push({nd, e.to});
+          }
+        }
+      }
+      if (dist[t] >= kInf) break;  // no augmenting path left
+      for (int v = 0; v < n_; ++v)
+        if (dist[v] < kInf) pot[v] += dist[v];
+      i64 push = want - flow;
+      for (int v = t; v != s; v = pv[v])
+        push = std::min(push, graph_[pv[v]][pe[v]].cap);
+      for (int v = t; v != s; v = pv[v]) {
+        Edge& e = graph_[pv[v]][pe[v]];
+        e.cap -= push;
+        graph_[v][e.rev].cap += push;
+        cost += push * e.cost;
+      }
+      flow += push;
+    }
+    return {flow, cost};
+  }
+
+  void BellmanFordPotentials(int s, std::vector<i64>* pot) {
+    std::vector<i64>& p = *pot;
+    std::fill(p.begin(), p.end(), kInf);
+    p[s] = 0;
+    for (int round = 0; round < n_; ++round) {
+      bool changed = false;
+      for (int v = 0; v < n_; ++v) {
+        if (p[v] >= kInf) continue;
+        for (const Edge& e : graph_[v]) {
+          if (e.cap > 0 && p[v] + e.cost < p[e.to]) {
+            p[e.to] = p[v] + e.cost;
+            changed = true;
+          }
+        }
+      }
+      if (!changed) break;
+    }
+    for (int v = 0; v < n_; ++v)
+      if (p[v] >= kInf) p[v] = 0;  // unreachable: any finite potential works
+  }
+
+  // ---- cost-scaling push-relabel on the forced circulation ----
+  // Adds a t->s arc with cap `want` and cost -BIG (BIG dominating every
+  // simple path cost), then finds a min-cost circulation by epsilon-
+  // scaling: refine(eps) saturates all negative-reduced-cost residual
+  // arcs and discharges active nodes until no excess remains. Exact once
+  // eps < 1/n in the n-scaled cost domain. Flow routed = flow on the
+  // forcing arc; if it is < want the instance is capacity-infeasible.
+  std::pair<i64, i64> SolveCostScaling(int s, int t, i64 want) {
+    const i64 maxc = MaxAbsCost();
+    const i64 big = (maxc + 1) * (i64)(n_ + 1);
+    int force_node = t;
+    AddEdge(t, s, want, -big);
+    const int force_idx = (int)graph_[t].size() - 1;
+
+    const i64 scale = (i64)n_;  // work in cost*n so eps==1 is exact
+    std::vector<i128> price(n_, 0);
+    auto rcost = [&](int v, const Edge& e) -> i128 {
+      return (i128)e.cost * scale + price[v] - price[e.to];
+    };
+
+    const i64 kAlpha = 8;
+    i64 eps = (maxc > big ? maxc : big) * scale;
+    std::vector<int> cur(n_, 0);
+    std::vector<i64> excess(n_, 0);
+    std::vector<int> active;
+    active.reserve(n_);
+
+    while (true) {
+      // --- refine(eps): saturate every negative-reduced-cost arc ---
+      for (int v = 0; v < n_; ++v) {
+        for (Edge& e : graph_[v]) {
+          if (e.cap > 0 && rcost(v, e) < 0) {
+            excess[v] -= e.cap;
+            excess[e.to] += e.cap;
+            graph_[e.to][e.rev].cap += e.cap;
+            e.cap = 0;
+          }
+        }
+      }
+      std::fill(cur.begin(), cur.end(), 0);
+      active.clear();
+      for (int v = 0; v < n_; ++v)
+        if (excess[v] > 0) active.push_back(v);
+
+      while (!active.empty()) {
+        int v = active.back();
+        active.pop_back();
+        while (excess[v] > 0) {
+          if (cur[v] == (int)graph_[v].size()) {
+            // relabel: largest price making some residual arc admissible
+            bool any = false;
+            i128 best = 0;
+            for (const Edge& e : graph_[v]) {
+              if (e.cap > 0) {
+                i128 np = price[e.to] - (i128)e.cost * scale - eps;
+                if (!any || np > best) best = np, any = true;
+              }
+            }
+            if (!any) {
+              // isolated excess: cannot happen in a circulation with
+              // reverse arcs present; defensive bail
+              std::fprintf(stderr, "cost_scaling: stuck node %d\n", v);
+              return {-1, 0};
+            }
+            price[v] = best;
+            cur[v] = 0;
+          }
+          Edge& e = graph_[v][cur[v]];
+          if (e.cap > 0 && rcost(v, e) < 0) {
+            i64 push = std::min(excess[v], e.cap);
+            e.cap -= push;
+            graph_[e.to][e.rev].cap += push;
+            excess[v] -= push;
+            bool was_inactive = excess[e.to] <= 0;
+            excess[e.to] += push;
+            if (was_inactive && excess[e.to] > 0) active.push_back(e.to);
+          } else {
+            ++cur[v];
+          }
+        }
+      }
+      if (eps == 1) break;
+      eps = std::max<i64>(1, eps / kAlpha);
+    }
+
+    // routed = flow on the forcing arc = want - residual cap
+    i64 routed = want - graph_[force_node][force_idx].cap;
+    i64 cost = 0;
+    for (size_t a = 0; a < input_arcs_.size(); ++a)
+      cost += FlowOnInputArc(a) * graph_[input_arcs_[a].first][input_arcs_[a].second].cost;
+    return {routed, cost};
+  }
+
+  i64 FlowOnInputArc(size_t a) const {
+    auto [v, i] = input_arcs_[a];
+    return input_cap_[a] - graph_[v][i].cap;
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string algo = argc > 1 ? argv[1] : "ssp";
+  if (algo != "ssp" && algo != "cost_scaling") {
+    std::fprintf(stderr, "usage: %s [ssp|cost_scaling] < dimacs\n", argv[0]);
+    return 2;
+  }
+
+  int n = -1;
+  long m = -1;
+  Solver solver;
+  std::vector<i64> supply;
+  std::vector<std::array<i64, 4>> arcs;  // src, dst, cap, cost (0-indexed)
+  {
+    char line[256];
+    while (std::fgets(line, sizeof line, stdin)) {
+      if (line[0] == 'c' || line[0] == '\n') continue;
+      if (line[0] == 'p') {
+        char kind[16];
+        if (std::sscanf(line, "p %15s %d %ld", kind, &n, &m) != 3 ||
+            std::strcmp(kind, "min") != 0) {
+          std::fprintf(stderr, "bad problem line\n");
+          return 2;
+        }
+        supply.assign(n, 0);
+      } else if (line[0] == 'n') {
+        long v = 0;
+        long long s = 0;
+        if (std::sscanf(line, "n %ld %lld", &v, &s) != 2 || v < 1 || v > n) {
+          std::fprintf(stderr, "bad node line: %s", line);
+          return 2;
+        }
+        supply[v - 1] = s;
+      } else if (line[0] == 'a') {
+        long u = 0, v = 0;
+        long long low = 0, cap = 0, cost = 0;
+        if (std::sscanf(line, "a %ld %ld %lld %lld %lld", &u, &v, &low, &cap,
+                        &cost) != 5 ||
+            u < 1 || u > n || v < 1 || v > n) {
+          std::fprintf(stderr, "bad arc line: %s", line);
+          return 2;
+        }
+        if (low != 0) {
+          std::fprintf(stderr, "nonzero lower bound unsupported\n");
+          return 2;
+        }
+        arcs.push_back({u - 1, v - 1, cap, cost});
+      }
+    }
+  }
+  if (n < 0) {
+    std::fprintf(stderr, "no problem line\n");
+    return 2;
+  }
+
+  // Super source/sink framing.
+  int S = n, T = n + 1;
+  solver.Init(n + 2);
+  for (auto& a : arcs)
+    solver.AddInputArc((int)a[0], (int)a[1], a[2], a[3]);
+  i64 total_supply = 0;
+  for (int v = 0; v < n; ++v) {
+    if (supply[v] > 0) {
+      solver.AddEdge(S, v, supply[v], 0);
+      total_supply += supply[v];
+    } else if (supply[v] < 0) {
+      solver.AddEdge(v, T, -supply[v], 0);
+    }
+  }
+
+  auto t0 = std::chrono::steady_clock::now();
+  std::pair<i64, i64> res = algo == "ssp"
+                                ? solver.SolveSSP(S, T, total_supply)
+                                : solver.SolveCostScaling(S, T, total_supply);
+  auto t1 = std::chrono::steady_clock::now();
+  double ms = std::chrono::duration<double, std::milli>(t1 - t0).count();
+
+  if (res.first != total_supply) {
+    std::printf("c infeasible routed=%lld of %lld\n", (long long)res.first,
+                (long long)total_supply);
+    return 1;
+  }
+  std::printf("s %lld\n", (long long)res.second);
+  for (size_t a = 0; a < arcs.size(); ++a) {
+    std::printf("f %lld %lld %lld\n", (long long)(arcs[a][0] + 1),
+                (long long)(arcs[a][1] + 1),
+                (long long)solver.FlowOnInputArc(a));
+  }
+  std::printf("c time_ms %.3f\n", ms);
+  return 0;
+}

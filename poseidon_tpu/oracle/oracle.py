@@ -1,0 +1,92 @@
+"""Python wrapper around the C++ MCMF oracle binary.
+
+Plays the role of Firmament's ``SolverDispatcher`` talking to cs2 /
+Flowlessly over a subprocess pipe (reference deploy/poseidon.cfg:8-11,
+solver stderr logging and ``--max_solver_runtime`` bounding included —
+poseidon.cfg:11,14-15). Builds the binary on demand with the in-tree
+Makefile; no network, no install.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import pathlib
+import subprocess
+
+import numpy as np
+
+from poseidon_tpu.graph.dimacs import parse_flow_output, write_dimacs
+from poseidon_tpu.graph.network import FlowNetwork
+
+log = logging.getLogger(__name__)
+
+_ORACLE_DIR = pathlib.Path(__file__).resolve().parent
+_BINARY = _ORACLE_DIR / "build" / "mcmf_oracle"
+
+
+class OracleInfeasible(RuntimeError):
+    """The instance's supplies cannot be routed."""
+
+
+@dataclasses.dataclass(frozen=True)
+class OracleResult:
+    cost: int
+    flows: np.ndarray       # int64 per real input arc, input order
+    solve_ms: float         # solver-internal timing
+    algorithm: str
+
+
+def _ensure_built() -> pathlib.Path:
+    src = _ORACLE_DIR / "mcmf_oracle.cc"
+    if not _BINARY.exists() or _BINARY.stat().st_mtime < src.stat().st_mtime:
+        proc = subprocess.run(
+            ["make", "-s", "build/mcmf_oracle"],
+            cwd=_ORACLE_DIR,
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"oracle build failed:\n{proc.stdout}\n{proc.stderr}"
+            )
+    return _BINARY
+
+
+def solve_oracle(
+    net: FlowNetwork,
+    algorithm: str = "ssp",
+    timeout_s: float = 1000.0,
+) -> OracleResult:
+    """Solve ``net`` exactly on CPU. ``timeout_s`` mirrors the reference's
+    --max_solver_runtime ceiling (1000 s, poseidon.cfg:14-15)."""
+    binary = _ensure_built()
+    text = write_dimacs(net)
+    try:
+        proc = subprocess.run(
+            [str(binary), algorithm],
+            input=text,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired as e:
+        raise RuntimeError(
+            f"oracle exceeded max solver runtime ({timeout_s}s)"
+        ) from e
+    if proc.stderr:
+        log.debug("oracle stderr: %s", proc.stderr.strip())
+    if proc.returncode == 1 and "infeasible" in proc.stdout:
+        raise OracleInfeasible(proc.stdout.strip())
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"oracle failed rc={proc.returncode}: {proc.stderr[:500]}"
+        )
+    cost, flows = parse_flow_output(proc.stdout, int(net.n_arcs))
+    solve_ms = 0.0
+    for line in proc.stdout.splitlines():
+        if line.startswith("c time_ms"):
+            solve_ms = float(line.split()[2])
+    return OracleResult(
+        cost=cost, flows=flows, solve_ms=solve_ms, algorithm=algorithm
+    )

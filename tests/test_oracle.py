@@ -1,0 +1,178 @@
+"""L2a' tests: C++ oracle vs hand-computed optima, SSP vs cost-scaling
+cross-checks, flow-conservation properties, infeasibility detection."""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.graph.network import FlowNetwork
+from poseidon_tpu.oracle import solve_oracle
+from poseidon_tpu.oracle.oracle import OracleInfeasible
+
+ALGOS = ["ssp", "cost_scaling"]
+
+
+def check_flow(net: FlowNetwork, flows: np.ndarray) -> None:
+    """Capacity + conservation invariants."""
+    h = net.to_host()
+    assert (flows >= 0).all()
+    assert (flows <= h["cap"]).all()
+    n = int(net.n_nodes)
+    balance = np.zeros(n, dtype=np.int64)
+    np.add.at(balance, h["src"], -flows)
+    np.add.at(balance, h["dst"], flows)
+    np.testing.assert_array_equal(balance, -h["supply"].astype(np.int64))
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+class TestHandInstances:
+    def test_single_arc(self, algo):
+        net = FlowNetwork.from_arrays([0], [1], [5], [3], [5, -5])
+        res = solve_oracle(net, algo)
+        assert res.cost == 15
+        assert res.flows.tolist() == [5]
+
+    def test_two_parallel_paths(self, algo):
+        # 0 -> 1 (cap 1, cost 1); 0 -> 1 (cap 5, cost 10): route 3 units
+        net = FlowNetwork.from_arrays(
+            [0, 0], [1, 1], [1, 5], [1, 10], [3, -3]
+        )
+        res = solve_oracle(net, algo)
+        assert res.cost == 1 * 1 + 2 * 10
+        check_flow(net, res.flows)
+
+    def test_diamond(self, algo):
+        # 0->1->3 cost 2, 0->2->3 cost 5; caps 1 each; route 2
+        net = FlowNetwork.from_arrays(
+            src=[0, 1, 0, 2],
+            dst=[1, 3, 2, 3],
+            cap=[1, 1, 1, 1],
+            cost=[1, 1, 2, 3],
+            supply=[2, 0, 0, -2],
+        )
+        res = solve_oracle(net, algo)
+        assert res.cost == 2 + 5
+        check_flow(net, res.flows)
+
+    def test_negative_cost_arc(self, algo):
+        # negative-cost arc must be exploited
+        net = FlowNetwork.from_arrays(
+            src=[0, 0], dst=[1, 1], cap=[2, 2], cost=[-4, 7],
+            supply=[3, -3],
+        )
+        res = solve_oracle(net, algo)
+        assert res.cost == 2 * -4 + 1 * 7
+        check_flow(net, res.flows)
+
+    def test_zero_supply(self, algo):
+        net = FlowNetwork.from_arrays([0], [1], [5], [3], [0, 0])
+        res = solve_oracle(net, algo)
+        assert res.cost == 0
+        assert res.flows.tolist() == [0]
+
+    def test_infeasible(self, algo):
+        net = FlowNetwork.from_arrays([0], [1], [2], [1], [5, -5])
+        with pytest.raises(OracleInfeasible):
+            solve_oracle(net, algo)
+
+
+def random_instance(rng, n_nodes=12, n_arcs=40, max_supply=6):
+    """Random feasible-by-construction instance: a bipartite-ish core plus
+    random arcs; a high-cost 'escape' arc per supply node guarantees
+    feasibility."""
+    supply = np.zeros(n_nodes, dtype=np.int64)
+    sources = rng.choice(n_nodes - 1, size=3, replace=False) + 1
+    amounts = rng.integers(1, max_supply, size=3)
+    supply[sources] = amounts
+    supply[0] = -amounts.sum()  # node 0 is the sink
+    src = rng.integers(0, n_nodes, size=n_arcs)
+    dst = rng.integers(0, n_nodes, size=n_arcs)
+    cap = rng.integers(0, 8, size=n_arcs)
+    cost = rng.integers(0, 50, size=n_arcs)
+    # escape arcs to sink
+    esc_src = sources
+    esc_dst = np.zeros(3, dtype=np.int64)
+    esc_cap = amounts
+    esc_cost = np.full(3, 1000, dtype=np.int64)
+    return FlowNetwork.from_arrays(
+        np.concatenate([src, esc_src]),
+        np.concatenate([dst, esc_dst]),
+        np.concatenate([cap, esc_cap]),
+        np.concatenate([cost, esc_cost]),
+        supply,
+    )
+
+
+class TestCrossAlgorithm:
+    def test_random_agreement(self):
+        rng = np.random.default_rng(42)
+        for trial in range(25):
+            net = random_instance(rng)
+            res_a = solve_oracle(net, "ssp")
+            res_b = solve_oracle(net, "cost_scaling")
+            assert res_a.cost == res_b.cost, f"trial {trial}"
+            check_flow(net, res_a.flows)
+            check_flow(net, res_b.flows)
+
+    def test_larger_random(self):
+        rng = np.random.default_rng(7)
+        net = random_instance(rng, n_nodes=60, n_arcs=400, max_supply=20)
+        res_a = solve_oracle(net, "ssp")
+        res_b = solve_oracle(net, "cost_scaling")
+        assert res_a.cost == res_b.cost
+        check_flow(net, res_b.flows)
+
+    def test_against_lp(self):
+        """Independent optimum via the LP relaxation (exact: the MCMF
+        constraint matrix is totally unimodular)."""
+        from scipy.optimize import linprog
+
+        rng = np.random.default_rng(123)
+        for _ in range(5):
+            net = random_instance(rng)
+            h = net.to_host()
+            m = len(h["src"])
+            A = np.zeros((int(net.n_nodes), m))
+            for a in range(m):
+                A[h["src"][a], a] += 1
+                A[h["dst"][a], a] -= 1
+            lp = linprog(
+                c=h["cost"], A_eq=A, b_eq=h["supply"],
+                bounds=list(zip([0] * m, h["cap"])), method="highs",
+            )
+            assert lp.status == 0
+            for algo in ALGOS:
+                res = solve_oracle(net, algo)
+                assert res.cost == round(lp.fun)
+                assert (res.flows * h["cost"]).sum() == res.cost
+                check_flow(net, res.flows)
+
+
+class TestBuilderGraphs:
+    def test_cluster_graph_solves(self):
+        from poseidon_tpu.cluster import Machine, Task, make_cluster
+        from poseidon_tpu.graph.builder import ArcKind, FlowGraphBuilder
+        from poseidon_tpu.graph.decompose import extract_placements
+
+        cluster = make_cluster(
+            [Machine(name=f"m{i}", max_tasks=3) for i in range(4)],
+            [Task(uid=f"p{i}") for i in range(10)],
+        )
+        net, meta = FlowGraphBuilder().build(cluster)
+        # trivial-ish costs: unsched expensive, cluster path cheap
+        h = net.to_host()
+        cost = np.zeros(meta.n_arcs, dtype=np.int64)
+        cost[meta.arc_kind == ArcKind.TASK_TO_UNSCHED] = 100
+        cost[meta.arc_kind == ArcKind.TASK_TO_CLUSTER] = 1
+        net = FlowNetwork.from_arrays(
+            h["src"], h["dst"], h["cap"], cost, h["supply"]
+        )
+        res = solve_oracle(net, "cost_scaling")
+        check_flow(net, res.flows)
+        # capacity 4*3=12 >= 10 tasks, so all place; cost = 10 * 1
+        assert res.cost == 10
+        pl = extract_placements(res.flows, meta, h["src"], h["dst"])
+        assert all(v is not None for v in pl.values())
+        # respect machine capacity
+        from collections import Counter
+        counts = Counter(pl.values())
+        assert max(counts.values()) <= 3

@@ -1,0 +1,3 @@
+from poseidon_tpu.ops.ssp import SolveResult, solve_ssp
+
+__all__ = ["SolveResult", "solve_ssp"]

@@ -1,0 +1,113 @@
+"""L1a differential tests: JAX SSP solver vs the C++ oracle."""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.graph.network import FlowNetwork
+from poseidon_tpu.ops import solve_ssp
+from poseidon_tpu.ops.ssp import solution_cost
+from poseidon_tpu.oracle import solve_oracle
+
+from tests.test_oracle import check_flow, random_instance
+
+
+def real_flows(net, result):
+    return np.asarray(result.flows)[: int(net.n_arcs)].astype(np.int64)
+
+
+class TestSSPBasics:
+    def test_single_arc(self):
+        net = FlowNetwork.from_arrays([0], [1], [5], [3], [5, -5])
+        res = solve_ssp(net)
+        assert bool(res.feasible)
+        assert real_flows(net, res).tolist() == [5]
+        assert solution_cost(net, res) == 15
+
+    def test_cheap_path_preferred(self):
+        net = FlowNetwork.from_arrays(
+            [0, 0], [1, 1], [1, 5], [1, 10], [3, -3]
+        )
+        res = solve_ssp(net)
+        assert solution_cost(net, res) == 21
+
+    def test_infeasible_detected(self):
+        net = FlowNetwork.from_arrays([0], [1], [2], [1], [5, -5])
+        res = solve_ssp(net)
+        assert not bool(res.feasible)
+        assert int(res.routed) == 2  # partial max flow still routed
+
+    def test_zero_supply(self):
+        net = FlowNetwork.from_arrays([0], [1], [5], [3], [0, 0])
+        res = solve_ssp(net)
+        assert bool(res.feasible)
+        assert solution_cost(net, res) == 0
+
+    def test_negative_arc_cost(self):
+        net = FlowNetwork.from_arrays(
+            [0, 0], [1, 1], [2, 2], [-4, 7], [3, -3]
+        )
+        res = solve_ssp(net)
+        assert solution_cost(net, res) == 2 * -4 + 1 * 7
+
+    def test_cost_bound_rejected(self):
+        net = FlowNetwork.from_arrays([0], [1], [1], [2**29], [1, -1])
+        with pytest.raises(ValueError, match="too large"):
+            solve_ssp(net)
+
+
+class TestSSPDifferential:
+    def test_random_vs_oracle(self):
+        rng = np.random.default_rng(1234)
+        for trial in range(20):
+            net = random_instance(rng)
+            oracle = solve_oracle(net, "ssp")
+            res = solve_ssp(net)
+            assert bool(res.feasible), f"trial {trial}"
+            assert solution_cost(net, res) == oracle.cost, f"trial {trial}"
+            check_flow(net, real_flows(net, res))
+
+    def test_larger_vs_oracle(self):
+        rng = np.random.default_rng(99)
+        net = random_instance(rng, n_nodes=50, n_arcs=300, max_supply=15)
+        oracle = solve_oracle(net, "cost_scaling")
+        res = solve_ssp(net)
+        assert bool(res.feasible)
+        assert solution_cost(net, res) == oracle.cost
+        check_flow(net, real_flows(net, res))
+
+    def test_builder_graph_vs_oracle(self):
+        from poseidon_tpu.cluster import Machine, Task, make_cluster
+        from poseidon_tpu.graph.builder import ArcKind, FlowGraphBuilder
+
+        rng = np.random.default_rng(5)
+        cluster = make_cluster(
+            [Machine(name=f"m{i}", rack=f"r{i % 3}", max_tasks=4)
+             for i in range(6)],
+            [Task(uid=f"p{i}", job=f"j{i % 3}",
+                  data_prefs={f"m{rng.integers(6)}": 10})
+             for i in range(20)],
+        )
+        net, meta = FlowGraphBuilder().build(cluster)
+        h = net.to_host()
+        cost = rng.integers(0, 100, size=meta.n_arcs)
+        cost[meta.arc_kind == ArcKind.TASK_TO_UNSCHED] = 1000
+        net = FlowNetwork.from_arrays(
+            h["src"], h["dst"], h["cap"], cost, h["supply"]
+        )
+        oracle = solve_oracle(net, "ssp")
+        res = solve_ssp(net)
+        assert bool(res.feasible)
+        assert solution_cost(net, res) == oracle.cost
+        check_flow(net, real_flows(net, res))
+
+    def test_shape_bucket_reuse(self):
+        """Two instances in the same padding bucket hit one compilation."""
+        rng = np.random.default_rng(3)
+        n1 = random_instance(rng)
+        n2 = random_instance(rng)
+        assert n1.num_arc_slots == n2.num_arc_slots
+        r1, r2 = solve_ssp(n1), solve_ssp(n2)
+        o1 = solve_oracle(n1, "ssp")
+        o2 = solve_oracle(n2, "ssp")
+        assert solution_cost(n1, r1) == o1.cost
+        assert solution_cost(n2, r2) == o2.cost

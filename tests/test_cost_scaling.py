@@ -1,0 +1,132 @@
+"""L1b differential tests: JAX cost-scaling solver vs the C++ oracle."""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.graph.network import FlowNetwork
+from poseidon_tpu.ops.cost_scaling import solve_cost_scaling, solution_cost
+from poseidon_tpu.oracle import solve_oracle
+
+from tests.test_oracle import check_flow, random_instance
+
+
+def real_flows(net, result):
+    return np.asarray(result.flows)[: int(net.n_arcs)].astype(np.int64)
+
+
+class TestCostScalingBasics:
+    def test_single_arc(self):
+        net = FlowNetwork.from_arrays([0], [1], [5], [3], [5, -5])
+        res = solve_cost_scaling(net)
+        assert bool(res.converged)
+        assert bool(res.feasible)
+        assert real_flows(net, res).tolist() == [5]
+        assert solution_cost(net, res) == 15
+
+    def test_cheap_path_preferred(self):
+        net = FlowNetwork.from_arrays(
+            [0, 0], [1, 1], [1, 5], [1, 10], [3, -3]
+        )
+        res = solve_cost_scaling(net)
+        assert bool(res.feasible)
+        assert solution_cost(net, res) == 21
+
+    def test_infeasible_reported(self):
+        net = FlowNetwork.from_arrays([0], [1], [2], [1], [5, -5])
+        res = solve_cost_scaling(net)
+        assert bool(res.converged)
+        assert not bool(res.feasible)
+        assert int(res.routed) == 2
+
+    def test_zero_supply(self):
+        net = FlowNetwork.from_arrays([0], [1], [5], [3], [0, 0])
+        res = solve_cost_scaling(net)
+        assert bool(res.feasible)
+        assert solution_cost(net, res) == 0
+
+    def test_negative_cost(self):
+        net = FlowNetwork.from_arrays(
+            [0, 0], [1, 1], [2, 2], [-4, 7], [3, -3]
+        )
+        res = solve_cost_scaling(net)
+        assert bool(res.feasible)
+        assert solution_cost(net, res) == 2 * -4 + 1 * 7
+
+
+class TestCostScalingDifferential:
+    def test_random_vs_oracle(self):
+        rng = np.random.default_rng(777)
+        for trial in range(20):
+            net = random_instance(rng)
+            oracle = solve_oracle(net, "cost_scaling")
+            res = solve_cost_scaling(net)
+            assert bool(res.converged), f"trial {trial}"
+            assert bool(res.feasible), f"trial {trial}"
+            assert solution_cost(net, res) == oracle.cost, f"trial {trial}"
+            check_flow(net, real_flows(net, res))
+
+    def test_larger_vs_oracle(self):
+        rng = np.random.default_rng(31)
+        net = random_instance(rng, n_nodes=50, n_arcs=300, max_supply=15)
+        oracle = solve_oracle(net, "cost_scaling")
+        res = solve_cost_scaling(net)
+        assert bool(res.converged)
+        assert bool(res.feasible)
+        assert solution_cost(net, res) == oracle.cost
+        check_flow(net, real_flows(net, res))
+
+    def test_builder_graph_vs_oracle(self):
+        from poseidon_tpu.cluster import Machine, Task, make_cluster
+        from poseidon_tpu.graph.builder import ArcKind, FlowGraphBuilder
+
+        rng = np.random.default_rng(8)
+        cluster = make_cluster(
+            [Machine(name=f"m{i}", rack=f"r{i % 3}", max_tasks=4)
+             for i in range(6)],
+            [Task(uid=f"p{i}", job=f"j{i % 3}",
+                  data_prefs={f"m{rng.integers(6)}": 10})
+             for i in range(20)],
+        )
+        net, meta = FlowGraphBuilder().build(cluster)
+        h = net.to_host()
+        cost = rng.integers(0, 100, size=meta.n_arcs)
+        cost[meta.arc_kind == ArcKind.TASK_TO_UNSCHED] = 1000
+        net = FlowNetwork.from_arrays(
+            h["src"], h["dst"], h["cap"], cost, h["supply"]
+        )
+        oracle = solve_oracle(net, "ssp")
+        res = solve_cost_scaling(net)
+        assert bool(res.feasible)
+        assert solution_cost(net, res) == oracle.cost
+        check_flow(net, real_flows(net, res))
+
+
+class TestWhatIfBatching:
+    def test_vmap_over_costs(self):
+        """The BASELINE 'what-if' config: vmap over perturbed cost models
+        of one topology, all solved in a single device program."""
+        import jax
+        import jax.numpy as jnp
+        import dataclasses
+        from poseidon_tpu.ops.cost_scaling import _solve
+
+        rng = np.random.default_rng(55)
+        base = random_instance(rng)
+        K = 8
+        costs = np.stack([
+            np.asarray(base.cost) + rng.integers(0, 5, size=base.num_arc_slots)
+            for _ in range(K)
+        ]).astype(np.int32)
+        # zero the padding cost slots to stay consistent
+        costs[:, int(base.n_arcs):] = 0
+
+        batched = jax.vmap(
+            lambda c: _solve(base.with_costs(c), 20000, 8)
+        )(jnp.asarray(costs))
+        for k in range(K):
+            net_k = base.with_costs(jnp.asarray(costs[k]))
+            oracle = solve_oracle(net_k, "cost_scaling")
+            fk = np.asarray(batched.flows[k])[: int(base.n_arcs)]
+            assert bool(batched.converged[k])
+            assert (fk.astype(np.int64) * np.asarray(net_k.cost)[: int(base.n_arcs)]).sum() == oracle.cost
+            check_flow(net_k, fk.astype(np.int64))

@@ -14,9 +14,11 @@ exactly like the C++ oracle. Each refine(eps) phase:
 1. saturates every residual arc with negative reduced cost (one vector
    op), creating excesses/deficits;
 2. runs discharge sweeps until no node holds positive excess. Per sweep,
-   every active node picks one admissible out-arc (segment_min over arc
-   ids), pushes min(excess, residual) along it (scatter-add), and every
-   active node with no admissible arc relabels to
+   every active node pushes on ALL of its admissible out-arcs at once —
+   amounts bounded by its excess via a segmented prefix-sum over the
+   src-sorted residual arc table (so a 10k-excess aggregator with 1000
+   out-arcs drains in one sweep, not 1000) — and every active node with
+   no admissible arc relabels to
    max over residual out-arcs of (price[dst] - cost') - eps
    (segment_max). Parallel relabels read pre-sweep prices; the rule
    preserves eps-optimality under that (a relabel only decreases its
@@ -98,8 +100,9 @@ def _augmented_tables(net: FlowNetwork):
     return fsrc, fdst, fcap, fcost, S, T, wanted, big
 
 
-@partial(jax.jit, static_argnames=("max_sweeps", "alpha"))
-def _solve(net: FlowNetwork, max_sweeps: int, alpha: int):
+@partial(jax.jit, static_argnames=("max_sweeps", "alpha", "sweeps_per_update"))
+def _solve(net: FlowNetwork, max_sweeps: int, alpha: int,
+           sweeps_per_update: int = 16):
     fsrc, fdst, fcap, fcost, S, T, wanted, big = _augmented_tables(net)
     F = fsrc.shape[0]
     NN = net.num_node_slots + 2
@@ -109,9 +112,7 @@ def _solve(net: FlowNetwork, max_sweeps: int, alpha: int):
     rdst = jnp.concatenate([fdst, fsrc])
     rcost = jnp.concatenate([fcost, -fcost]) * scale  # scaled cost domain
     arc_ids = jnp.arange(2 * F, dtype=jnp.int32)
-    SENT = jnp.int32(2 * F)  # sentinel arc id
-    # sentinel maps to scratch node slot NN (excess array has NN+1 slots)
-    rdst_ext = jnp.concatenate([rdst, jnp.array([NN], jnp.int32)])
+    SENT = jnp.int32(2 * F)
 
     def rescap(flow):
         return jnp.concatenate([fcap - flow, flow])
@@ -120,44 +121,103 @@ def _solve(net: FlowNetwork, max_sweeps: int, alpha: int):
         flow, excess, price, eps, sweeps = carry
         res = rescap(flow)
         rc = rcost + price[rsrc] - price[rdst]
-        active = excess[:NN] > 0
+        active = excess > 0
         adm = (res > 0) & (rc < 0) & active[rsrc]
+        adm_amt = jnp.where(adm, res, 0).astype(I64)
 
-        # one admissible arc per active node (lowest arc id)
+        # Full parallel discharge without any scan op (cumsum lowers to
+        # a VMEM-hungry reduce-window on TPU for emulated int64):
+        # proportional shares push floor(excess * amt / total) on every
+        # admissible arc, and the node's lowest-id admissible arc takes
+        # the remainder — so a node with excess >= total admissible
+        # capacity saturates everything in one sweep, and a node with
+        # small excess still pushes >= 1 unit per sweep.
+        total = jax.ops.segment_sum(adm_amt, rsrc, num_segments=NN)
+        exc64 = excess.astype(I64)
+        tot_a = total[rsrc]
+        exc_a = exc64[rsrc]
+        prop = jnp.minimum(
+            adm_amt, (exc_a * adm_amt) // jnp.maximum(tot_a, 1)
+        )
+        sum_prop = jax.ops.segment_sum(prop, rsrc, num_segments=NN)
         choice = jax.ops.segment_min(
             jnp.where(adm, arc_ids, SENT), rsrc, num_segments=NN
         )
-        has_adm = choice < SENT
-        push_node = active & has_adm
-        a_sel = jnp.where(push_node, choice, SENT)
-
-        res_ext = jnp.concatenate([res, jnp.zeros(1, jnp.int32)])
-        delta = jnp.minimum(excess[:NN], res_ext[a_sel])
-        delta = jnp.where(push_node, delta, 0).astype(jnp.int32)
-
-        # apply pushes: forward slot += delta, backward slot -= delta
-        is_fwd = a_sel < F
-        fwd_slot = jnp.where(is_fwd, a_sel, F)           # F = scratch
-        bwd_slot = jnp.where(is_fwd, F, a_sel - F)
-        flow_ext = jnp.concatenate([flow, jnp.zeros(1, jnp.int32)])
-        flow_ext = flow_ext.at[fwd_slot].add(delta)
-        flow_ext = flow_ext.at[bwd_slot].add(-delta)
-        flow = flow_ext[:F]
-
-        excess = excess.at[:NN].add(-delta)
-        excess = excess.at[rdst_ext[a_sel]].add(delta)
-
-        # relabel active nodes with no admissible arc
-        relabel_node = active & ~has_adm
-        target = jax.ops.segment_max(
-            jnp.where(res > 0, price[rdst] - rcost, NEG_INF),
-            rsrc,
-            num_segments=NN,
+        is_chosen = adm & (arc_ids == choice[rsrc])
+        leftover = (exc64 - sum_prop)[rsrc]
+        extra = jnp.where(
+            is_chosen, jnp.minimum(adm_amt - prop, leftover), 0
         )
-        price = jnp.where(
-            relabel_node & (target > NEG_INF), target - eps, price
-        )
+        push32 = (prop + extra).astype(jnp.int32)
+
+        flow = flow + push32[:F] - push32[F:]
+        out = jax.ops.segment_sum(push32, rsrc, num_segments=NN)
+        inn = jax.ops.segment_sum(push32, rdst, num_segments=NN)
+        excess = excess + inn - out
+
+        # Relabel active nodes with no admissible arc by exactly eps.
+        # (The jump-to-max relabel — price := max over residual arcs of
+        # (price[dst] - cost') - eps — feeds a segment-reduction result
+        # into the price update; on the axon TPU relay that op pattern
+        # trips a device fault whose recovery degrades the whole process
+        # to per-kernel dispatch, ~500x slower. Relabel-by-eps keeps the
+        # price update elementwise; long-range price moves are the
+        # global update's job anyway.)
+        has_adm = jax.ops.segment_max(
+            adm.astype(jnp.int32), rsrc, num_segments=NN
+        ) > 0
+        price = jnp.where(active & ~has_adm, price - eps, price)
         return flow, excess, price, eps, sweeps + 1
+
+    INF_K = jnp.int64(2**50)
+    BF_BURST = 8
+
+    def global_update(flow, excess, price, eps):
+        """Global price update (the cs2 'price update' heuristic).
+
+        Computes for every node the least k such that lowering its price
+        by k*eps opens an admissible path to a deficit node — a
+        multi-source shortest-path in arc lengths
+        max(0, floor(rc/eps) + 1) over residual arcs — then applies
+        price -= k*eps. Collapses the one-relabel-per-sweep epsilon wave
+        into one Bellman-Ford whose round count is the hop depth of the
+        graph (shallow for scheduling topologies). Only a fully
+        converged BF is applied: a partial result could break
+        eps-optimality.
+        """
+        res = rescap(flow)
+        rc = rcost + price[rsrc] - price[rdst]
+        ln = jnp.where(res > 0, jnp.maximum(0, rc // eps + 1), INF_K)
+        d0 = jnp.where(excess < 0, 0, INF_K).astype(I64)
+
+        def bf_round(state):
+            d, _, it = state
+            via = jnp.where(
+                (res > 0) & (d[rdst] < INF_K), d[rdst] + ln, INF_K
+            )
+            best = jax.ops.segment_min(via, rsrc, num_segments=NN)
+            new = jnp.minimum(d, best)
+            return new, jnp.any(new < d), it + 1
+
+        # burst-structured: BF_BURST rounds per while iteration (per-
+        # iteration control-flow overhead dominates wall time on the
+        # remote-TPU relay, so iterations are made fat; converged rounds
+        # are no-ops)
+        def bf_burst(state):
+            return jax.lax.scan(
+                lambda s, _: (bf_round(s), None), state, None,
+                length=BF_BURST,
+            )[0]
+
+        d, changed, _ = jax.lax.while_loop(
+            lambda s: s[1] & (s[2] < NN),
+            bf_burst,
+            (d0, jnp.bool_(True), jnp.int32(0)),
+        )
+        converged = ~changed
+        k = jnp.where(d < INF_K, d, 0)
+        price = jnp.where(converged, price - k * eps, price)
+        return price
 
     def refine(flow, price, eps, sweeps_total):
         # saturate negative-reduced-cost residual arcs
@@ -165,18 +225,33 @@ def _solve(net: FlowNetwork, max_sweeps: int, alpha: int):
         rc = rcost + price[rsrc] - price[rdst]
         amt = jnp.where((res > 0) & (rc < 0), res, 0).astype(jnp.int32)
         flow = flow + amt[:F] - amt[F:]
-        excess = jnp.zeros(NN + 1, jnp.int32)
+        excess = jnp.zeros(NN, jnp.int32)
         excess = excess.at[rsrc].add(-amt)
         excess = excess.at[rdst].add(amt)
 
-        def cond(carry):
+        # macro loop: global price update, then a fixed scan burst of
+        # sweeps_per_update discharge sweeps (converged sweeps are
+        # no-ops); repeat until no excess. Burst structure keeps the
+        # number of control-flow iterations small — per-iteration
+        # overhead dominates on the remote-TPU relay.
+        def one_burst(carry):
+            flow_, excess_, price_, eps_, sweeps_ = carry
+            price_ = global_update(flow_, excess_, price_, eps_)
+            return jax.lax.scan(
+                lambda c, _: (sweep(c), None),
+                (flow_, excess_, price_, eps_, sweeps_),
+                None,
+                length=sweeps_per_update,
+            )[0]
+
+        def outer_cond(carry):
             _, excess_, _, _, sweeps_ = carry
-            return jnp.any(excess_[:NN] > 0) & (sweeps_ < max_sweeps)
+            return jnp.any(excess_ > 0) & (sweeps_ < max_sweeps)
 
         flow, excess, price, _, sweeps_total = jax.lax.while_loop(
-            cond, sweep, (flow, excess, price, eps, sweeps_total)
+            outer_cond, one_burst, (flow, excess, price, eps, sweeps_total)
         )
-        return flow, price, ~jnp.any(excess[:NN] > 0), sweeps_total
+        return flow, price, ~jnp.any(excess > 0), sweeps_total
 
     def phase_body(carry):
         flow, price, eps, sweeps_total, phases, ok, done = carry
@@ -219,6 +294,7 @@ def solve_cost_scaling(
     *,
     max_sweeps: int | None = None,
     alpha: int = 8,
+    sweeps_per_update: int = 16,
 ) -> CostScalingResult:
     """Solve ``net`` exactly on device via cost-scaling push-relabel.
 
@@ -229,7 +305,7 @@ def solve_cost_scaling(
     if max_sweeps is None:
         # generous: phases * O(per-phase sweeps); sized empirically
         max_sweeps = 200 * (net.num_node_slots.bit_length() + 8) * 8
-    return _solve(net, max_sweeps, alpha)
+    return _solve(net, max_sweeps, alpha, sweeps_per_update)
 
 
 def solution_cost(net: FlowNetwork, result: CostScalingResult) -> int:

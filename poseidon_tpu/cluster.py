@@ -69,6 +69,9 @@ class Task:
     machine: str = ""
     # Quincy data locality: {machine_or_rack_name: locality_weight}
     data_prefs: dict[str, int] = dataclasses.field(default_factory=dict)
+    # Rounds this task has sat unscheduled — Quincy's unscheduled-cost input
+    # (grows each round the bridge re-offers the task; SURVEY.md section 7.4)
+    wait_rounds: int = 0
 
     @property
     def job_id(self) -> str:

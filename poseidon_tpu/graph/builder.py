@@ -59,6 +59,9 @@ class GraphMeta:
     arc_task: np.ndarray      # int32[n_arcs]  task index or -1
     arc_machine: np.ndarray   # int32[n_arcs]  machine index or -1
     arc_rack: np.ndarray      # int32[n_arcs]  rack index or -1
+    arc_weight: np.ndarray    # int32[n_arcs]  data-locality weight (pref
+                              # arcs; 0 elsewhere) — Quincy's input
+    task_wait: np.ndarray     # int32[n_tasks] rounds each task has waited
     task_node: np.ndarray     # int32[n_tasks] node id of each task
     machine_node: np.ndarray  # int32[n_machines]
     node_machine: np.ndarray  # int32[n_nodes] machine index or -1
@@ -126,8 +129,10 @@ class FlowGraphBuilder:
         a_machine: list[int] = []
         a_rack: list[int] = []
 
+        a_weight: list[int] = []
+
         def arc(s: int, d: int, c: int, k: ArcKind,
-                ti: int = -1, mi: int = -1, ri: int = -1) -> None:
+                ti: int = -1, mi: int = -1, ri: int = -1, wt: int = 0) -> None:
             src.append(s)
             dst.append(d)
             cap.append(c)
@@ -135,6 +140,7 @@ class FlowGraphBuilder:
             a_task.append(ti)
             a_machine.append(mi)
             a_rack.append(ri)
+            a_weight.append(wt)
 
         job_task_count = np.zeros(J, dtype=np.int64)
         for ti, t in enumerate(tasks):
@@ -155,13 +161,15 @@ class FlowGraphBuilder:
             arc(tnode, unsched_base + ji, 1, ArcKind.TASK_TO_UNSCHED, ti=ti)
             arc(tnode, CLUSTER, 1, ArcKind.TASK_TO_CLUSTER, ti=ti)
             if self.pref_arcs:
-                for name in t.data_prefs:
+                for name, weight in t.data_prefs.items():
                     if name in midx:
                         arc(tnode, machine_base + midx[name], 1,
-                            ArcKind.TASK_TO_MACHINE, ti=ti, mi=midx[name])
+                            ArcKind.TASK_TO_MACHINE, ti=ti, mi=midx[name],
+                            wt=int(weight))
                     elif name in rack_idx:
                         arc(tnode, rack_base + rack_idx[name], 1,
-                            ArcKind.TASK_TO_RACK, ti=ti, ri=rack_idx[name])
+                            ArcKind.TASK_TO_RACK, ti=ti, ri=rack_idx[name],
+                            wt=int(weight))
 
         # aggregator -> machine arcs
         for mi, m in enumerate(machines):
@@ -196,6 +204,9 @@ class FlowGraphBuilder:
             arc_task=np.array(a_task, dtype=np.int32),
             arc_machine=np.array(a_machine, dtype=np.int32),
             arc_rack=np.array(a_rack, dtype=np.int32),
+            arc_weight=np.array(a_weight, dtype=np.int32),
+            task_wait=np.array([t.wait_rounds for t in tasks],
+                               dtype=np.int32),
             task_node=np.arange(task_base, task_base + T, dtype=np.int32),
             machine_node=np.arange(machine_base, machine_base + M,
                                    dtype=np.int32),

@@ -1,0 +1,21 @@
+"""L3' cost-model layer: vectorized arc pricing + sample knowledge base."""
+
+from poseidon_tpu.models.costs import (  # noqa: F401
+    COST_CAP,
+    COST_MODELS,
+    COST_MODEL_SELECTORS,
+    CostInputs,
+    build_cost_inputs,
+    coco_cost,
+    get_cost_model,
+    octopus_cost,
+    quincy_cost,
+    random_cost,
+    trivial_cost,
+    wharemap_cost,
+)
+from poseidon_tpu.models.knowledge import (  # noqa: F401
+    KnowledgeBase,
+    MachineSample,
+    TaskSample,
+)

@@ -1,0 +1,296 @@
+"""L3' cost models: pure vectorized arc-pricing functions + registry.
+
+The reference selects a pluggable Firmament cost policy by integer flag —
+``--flow_scheduling_cost_model=6`` with the comment "Load-balancing
+policy" (reference deploy/poseidon.cfg:6-7, README.md:85-87); the policies
+themselves live in the absent Firmament tree (SURVEY.md section 2.2), so
+these are re-designs of the documented intent, not ports: Trivial,
+Random, Quincy (data locality), Whare-Map (interference from samples),
+CoCo (multi-dimensional co-location), Octopus (load balancing — the
+selector the shipped config uses).
+
+Each model is a pure function ``(CostInputs) -> int32[E]`` over the padded
+arc table, safe under ``jax.jit`` and ``jax.vmap`` (the what-if batching
+path, SURVEY.md section 2.4): recomputing costs per round is one fused
+device op, not a graph rebuild. Costs are bounded to [0, COST_CAP] so the
+solvers' scaled integer domains stay inside int32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poseidon_tpu.graph.builder import ArcKind, GraphMeta
+from poseidon_tpu.graph.network import FlowNetwork, pad_bucket
+
+# Bound on any single arc cost. With the solvers' n-scaling this keeps the
+# price domain well inside int32 for clusters up to ~100k node slots.
+COST_CAP = 10_000
+_SCALE = 10
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CostInputs:
+    """Device-resident pricing inputs, padded to static buckets.
+
+    Per-arc arrays are aligned to the FlowNetwork's arc slots; ``task`` /
+    ``machine`` / ``rack`` are clipped to 0 where not applicable so they
+    are always safe gather indices — ``valid``/kind masks decide whether
+    the gathered value is used.
+    """
+
+    kind: jax.Array          # int32[E] ArcKind (padding: -1)
+    task: jax.Array          # int32[E] gather-safe task index
+    machine: jax.Array       # int32[E] gather-safe machine index
+    weight: jax.Array        # int32[E] data-locality weight
+    valid: jax.Array         # bool[E]  real (non-padding) arcs
+    task_wait: jax.Array     # int32[Tp] rounds waited per task
+    task_input: jax.Array    # int32[Tp] total input data units per task
+    task_cpu: jax.Array      # int32[Tp] requested milli-cores
+    task_mem_kb: jax.Array   # int32[Tp] requested memory
+    task_usage: jax.Array    # f32[Tp] sampled cpu usage (cores)
+    machine_load: jax.Array  # f32[Mp] 1 - mean idle, in [0, 1]
+    machine_mem_free: jax.Array  # f32[Mp] mean free-mem fraction [0, 1]
+    machine_used_slots: jax.Array  # int32[Mp] running tasks per machine
+
+
+def build_cost_inputs(
+    net: FlowNetwork,
+    meta: GraphMeta,
+    *,
+    task_cpu_milli: np.ndarray | None = None,
+    task_mem_kb: np.ndarray | None = None,
+    task_usage: np.ndarray | None = None,
+    machine_load: np.ndarray | None = None,
+    machine_mem_free: np.ndarray | None = None,
+    machine_used_slots: np.ndarray | None = None,
+) -> CostInputs:
+    """Assemble padded pricing inputs from builder metadata + KB aggregates.
+
+    The sample-derived arrays (``machine_load`` etc.) come from
+    ``KnowledgeBase`` aggregates; they default to an idle, unsampled
+    cluster. Shapes: per-task arrays length n_tasks, per-machine length
+    n_machines (padded here).
+    """
+    E = net.num_arc_slots
+    T = len(meta.task_uids)
+    M = len(meta.machine_names)
+    Tp, Mp = pad_bucket(max(T, 1)), pad_bucket(max(M, 1))
+
+    def pad_arc(a: np.ndarray, fill: int) -> np.ndarray:
+        out = np.full(E, fill, np.int32)
+        out[: meta.n_arcs] = a
+        return out
+
+    def padv(a, n, dtype):
+        out = np.zeros(n, dtype)
+        if a is not None:
+            a = np.asarray(a)
+            out[: a.shape[0]] = a
+        return out
+
+    # Total input data per task = sum of its pref-arc weights (Quincy's
+    # "how much data could be local" denominator).
+    tin = np.zeros(Tp, np.int64)
+    np.add.at(tin, np.maximum(meta.arc_task, 0),
+              np.where(meta.arc_task >= 0, meta.arc_weight, 0))
+    return CostInputs(
+        kind=jnp.asarray(pad_arc(meta.arc_kind.astype(np.int32), -1)),
+        task=jnp.asarray(pad_arc(np.maximum(meta.arc_task, 0), 0)),
+        machine=jnp.asarray(pad_arc(np.maximum(meta.arc_machine, 0), 0)),
+        weight=jnp.asarray(pad_arc(meta.arc_weight, 0)),
+        valid=jnp.asarray(np.arange(E) < meta.n_arcs),
+        task_wait=jnp.asarray(padv(meta.task_wait, Tp, np.int32)),
+        task_input=jnp.asarray(np.minimum(tin, COST_CAP).astype(np.int32)),
+        task_cpu=jnp.asarray(padv(task_cpu_milli, Tp, np.int32)),
+        task_mem_kb=jnp.asarray(padv(task_mem_kb, Tp, np.int32)),
+        task_usage=jnp.asarray(padv(task_usage, Tp, np.float32)),
+        machine_load=jnp.asarray(padv(machine_load, Mp, np.float32)),
+        machine_mem_free=jnp.asarray(
+            padv(machine_mem_free, Mp, np.float32)
+            if machine_mem_free is not None else np.ones(Mp, np.float32)
+        ),
+        machine_used_slots=jnp.asarray(
+            padv(machine_used_slots, Mp, np.int32)
+        ),
+    )
+
+
+def _finish(inputs: CostInputs, cost: jax.Array) -> jax.Array:
+    """Clamp to the documented domain and zero the padding slots."""
+    cost = jnp.clip(cost, 0, COST_CAP).astype(jnp.int32)
+    return jnp.where(inputs.valid, cost, 0)
+
+
+def _kind(inputs: CostInputs, k: ArcKind) -> jax.Array:
+    return inputs.kind == jnp.int32(int(k))
+
+
+# ---- the models ----
+
+def trivial_cost(inputs: CostInputs) -> jax.Array:
+    """Fixed-fee policy: schedule anywhere, mildly prefer scheduling.
+
+    Wildcard (cluster) routing costs a small constant, leaving a task
+    unscheduled a larger one; every other arc is free.
+    """
+    c = jnp.zeros_like(inputs.kind)
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_UNSCHED), 5 * _SCALE, c)
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_CLUSTER), 2 * _SCALE, c)
+    return _finish(inputs, c)
+
+
+def random_cost(inputs: CostInputs, seed: int = 42) -> jax.Array:
+    """Deterministic pseudo-random arc costs (debug / fuzz policy)."""
+    x = (inputs.kind.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         + inputs.task.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+         + inputs.machine.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+         + jnp.uint32(seed))
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x2C1B3C6D)
+    x = x ^ (x >> 12)
+    c = (x % jnp.uint32(100)).astype(jnp.int32)
+    # keep unsched clearly the worst option so random still schedules
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_UNSCHED), COST_CAP // 2, c)
+    return _finish(inputs, c)
+
+
+def quincy_cost(inputs: CostInputs) -> jax.Array:
+    """Data-locality policy (Quincy-style).
+
+    A preference arc's cost is the data the task would have to fetch
+    remotely if placed there (total input minus what is local at the
+    target); the wildcard path assumes nothing is local; the unscheduled
+    arc grows with how long the task has waited, so starvation pressure
+    eventually overrides locality.
+    """
+    total = inputs.task_input[inputs.task]
+    remote = jnp.maximum(total - inputs.weight, 0)
+    c = jnp.zeros_like(inputs.kind)
+    pref = (_kind(inputs, ArcKind.TASK_TO_MACHINE)
+            | _kind(inputs, ArcKind.TASK_TO_RACK))
+    c = jnp.where(pref, remote, c)
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_CLUSTER), total + _SCALE, c)
+    wait = inputs.task_wait[inputs.task]
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_UNSCHED),
+                  5 * _SCALE * (wait + 1), c)
+    # crossing a rack boundary to reach the machine costs a hop
+    c = jnp.where(_kind(inputs, ArcKind.RACK_TO_MACHINE), _SCALE // 2, c)
+    return _finish(inputs, c)
+
+
+def octopus_cost(inputs: CostInputs) -> jax.Array:
+    """Load-balancing policy — the reference's shipped selector
+    (deploy/poseidon.cfg:6-7): busy machines price up, so flow spreads.
+    """
+    load = (inputs.machine_load * 100).astype(jnp.int32)
+    slots = inputs.machine_used_slots * _SCALE
+    per_machine = (load + slots)[inputs.machine]
+    c = jnp.zeros_like(inputs.kind)
+    to_machine = (_kind(inputs, ArcKind.CLUSTER_TO_MACHINE)
+                  | _kind(inputs, ArcKind.RACK_TO_MACHINE)
+                  | _kind(inputs, ArcKind.TASK_TO_MACHINE))
+    c = jnp.where(to_machine, per_machine, c)
+    c = jnp.where(_kind(inputs, ArcKind.MACHINE_TO_SINK), per_machine, c)
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_CLUSTER), _SCALE, c)
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_UNSCHED), COST_CAP // 4, c)
+    return _finish(inputs, c)
+
+
+def wharemap_cost(inputs: CostInputs) -> jax.Array:
+    """Interference scoring from observed samples (Whare-Map-style).
+
+    Prices a task onto a machine by the product of the machine's observed
+    load and the task's observed hunger — co-locating a hot task on a hot
+    machine is the expensive corner. Unsampled entities degrade to pure
+    load balancing.
+    """
+    hunger = jnp.clip(inputs.task_usage[inputs.task]
+                      + inputs.task_cpu[inputs.task].astype(jnp.float32)
+                      / 1000.0, 0.1, 8.0)
+    load = inputs.machine_load[inputs.machine]
+    interf = (hunger * load * 100.0).astype(jnp.int32)
+    c = jnp.zeros_like(inputs.kind)
+    direct = (_kind(inputs, ArcKind.TASK_TO_MACHINE)
+              | _kind(inputs, ArcKind.CLUSTER_TO_MACHINE)
+              | _kind(inputs, ArcKind.RACK_TO_MACHINE))
+    c = jnp.where(direct, interf, c)
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_CLUSTER), 2 * _SCALE, c)
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_UNSCHED), COST_CAP // 4, c)
+    return _finish(inputs, c)
+
+
+def coco_cost(inputs: CostInputs) -> jax.Array:
+    """Multi-dimensional co-location policy (CoCo-style).
+
+    Cost is the tightest normalized resource fit across CPU and memory:
+    placing a demanding task on a machine with little headroom is
+    penalized superlinearly, so the solver packs across dimensions.
+    """
+    cpu_req = inputs.task_cpu[inputs.task].astype(jnp.float32) / 1000.0
+    mem_req = inputs.task_mem_kb[inputs.task].astype(jnp.float32)
+    cpu_head = jnp.maximum(1.0 - inputs.machine_load[inputs.machine], 0.05)
+    mem_head = jnp.maximum(inputs.machine_mem_free[inputs.machine], 0.05)
+    fit = jnp.maximum(cpu_req / cpu_head,
+                      mem_req / (mem_head * (1 << 20)))
+    sq = jnp.clip(fit, 0.0, 4.0)
+    score = (sq * sq * 100.0).astype(jnp.int32)
+    c = jnp.zeros_like(inputs.kind)
+    placing = (_kind(inputs, ArcKind.TASK_TO_MACHINE)
+               | _kind(inputs, ArcKind.CLUSTER_TO_MACHINE)
+               | _kind(inputs, ArcKind.RACK_TO_MACHINE))
+    c = jnp.where(placing, score, c)
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_CLUSTER), 3 * _SCALE, c)
+    wait = inputs.task_wait[inputs.task]
+    c = jnp.where(_kind(inputs, ArcKind.TASK_TO_UNSCHED),
+                  COST_CAP // 4 + 5 * _SCALE * wait, c)
+    return _finish(inputs, c)
+
+
+CostModelFn = Callable[[CostInputs], jax.Array]
+
+# Name registry + the reference's integer selector compatibility
+# (deploy/poseidon.cfg:7 selects 6, the load-balancing policy).
+COST_MODELS: dict[str, CostModelFn] = {
+    "trivial": trivial_cost,
+    "random": random_cost,
+    "quincy": quincy_cost,
+    "wharemap": wharemap_cost,
+    "coco": coco_cost,
+    "octopus": octopus_cost,
+}
+
+COST_MODEL_SELECTORS: dict[int, str] = {
+    0: "trivial",
+    1: "random",
+    3: "quincy",
+    4: "wharemap",
+    5: "coco",
+    6: "octopus",
+}
+
+
+def get_cost_model(name_or_selector: str | int) -> CostModelFn:
+    """Look up a cost model by name or by the reference's integer flag."""
+    if isinstance(name_or_selector, int):
+        try:
+            name = COST_MODEL_SELECTORS[name_or_selector]
+        except KeyError:
+            raise KeyError(
+                f"unknown cost model selector {name_or_selector}; "
+                f"known: {sorted(COST_MODEL_SELECTORS)}"
+            ) from None
+    else:
+        name = name_or_selector
+    try:
+        return COST_MODELS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown cost model {name!r}; known: {sorted(COST_MODELS)}"
+        ) from None

@@ -1,0 +1,111 @@
+"""KnowledgeBase': bounded ring buffers of cluster utilization samples.
+
+The reference feeds node/pod utilization into Firmament's KnowledgeBase
+every poll tick (reference src/firmament/knowledge_base_populator.cc:65-99:
+``AddMachineSample`` / ``AddTaskSample``), bounded by
+``--max_sample_queue_size=100`` (reference deploy/poseidon.cfg:5); the cost
+models price interference and load from those samples (SURVEY.md section
+2.2). Here the store is a fixed-shape numpy ring per machine/task so the
+aggregates the cost models consume are O(1) vectorized reductions, ready
+to ship to device as dense arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+DEFAULT_QUEUE_SIZE = 100  # reference deploy/poseidon.cfg:5
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineSample:
+    """One utilization sample for a machine.
+
+    Mirrors the fields the reference's populator fills into
+    ``MachinePerfStatisticsSample`` (knowledge_base_populator.cc:68-81):
+    free RAM and per-cpu idle fraction (the reference fabricates idle from
+    allocatable/capacity counts, :35-63 — here it is a real input).
+    """
+
+    cpu_idle: float        # [0, 1] fraction of CPU idle
+    mem_free_frac: float   # [0, 1] fraction of memory free
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskSample:
+    """One usage sample for a running task (TaskPerfStatisticsSample,
+    knowledge_base_populator.cc:84-99, plus the final-report fields the
+    reference stubs out at :101-113)."""
+
+    cpu_usage: float       # cores actually used
+    mem_usage_kb: int
+
+
+class KnowledgeBase:
+    """Fixed-capacity sample rings keyed by machine / task name.
+
+    ``machine_load()`` and friends return dense arrays aligned to a caller
+    -supplied name order, so cost models can consume them directly as
+    device arrays.
+    """
+
+    def __init__(self, queue_size: int = DEFAULT_QUEUE_SIZE):
+        if queue_size <= 0:
+            raise ValueError("queue_size must be positive")
+        self.queue_size = queue_size
+        self._machines: dict[str, tuple[np.ndarray, np.ndarray, int]] = {}
+        self._tasks: dict[str, tuple[np.ndarray, np.ndarray, int]] = {}
+
+    # ---- ingestion ----
+
+    def add_machine_sample(self, name: str, sample: MachineSample) -> None:
+        if name not in self._machines:
+            self._machines[name] = (
+                np.zeros(self.queue_size, np.float32),
+                np.zeros(self.queue_size, np.float32),
+                0,
+            )
+        idle, free, n = self._machines[name]
+        idle[n % self.queue_size] = sample.cpu_idle
+        free[n % self.queue_size] = sample.mem_free_frac
+        self._machines[name] = (idle, free, n + 1)
+
+    def add_task_sample(self, uid: str, sample: TaskSample) -> None:
+        if uid not in self._tasks:
+            self._tasks[uid] = (
+                np.zeros(self.queue_size, np.float32),
+                np.zeros(self.queue_size, np.float32),
+                0,
+            )
+        cpu, mem, n = self._tasks[uid]
+        cpu[n % self.queue_size] = sample.cpu_usage
+        mem[n % self.queue_size] = float(sample.mem_usage_kb)
+        self._tasks[uid] = (cpu, mem, n + 1)
+
+    # ---- aggregates (dense, order given by the caller) ----
+
+    def _mean(self, store, names, which: int, default: float) -> np.ndarray:
+        out = np.full(len(names), default, np.float32)
+        for i, name in enumerate(names):
+            entry = store.get(name)
+            if entry is None or entry[2] == 0:
+                continue
+            buf, n = entry[which], min(entry[2], self.queue_size)
+            out[i] = float(buf[:n].mean())
+        return out
+
+    def machine_cpu_idle(self, names: list[str]) -> np.ndarray:
+        """Mean idle fraction per machine; 1.0 (fully idle) if unsampled."""
+        return self._mean(self._machines, names, 0, 1.0)
+
+    def machine_mem_free(self, names: list[str]) -> np.ndarray:
+        return self._mean(self._machines, names, 1, 1.0)
+
+    def machine_load(self, names: list[str]) -> np.ndarray:
+        """1 - idle: the load signal Octopus/CoCo price (0 if unsampled)."""
+        return 1.0 - self.machine_cpu_idle(names)
+
+    def task_cpu_usage(self, uids: list[str]) -> np.ndarray:
+        return self._mean(self._tasks, uids, 0, 0.0)

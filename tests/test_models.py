@@ -1,0 +1,179 @@
+"""Cost-model layer tests: registry, pricing semantics, vmap batching.
+
+Models are validated end to end: builder -> cost inputs -> priced network
+-> exact solve, checked against the C++ oracle (the seam the reference
+exercises via --flow_scheduling_cost_model, deploy/poseidon.cfg:7).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from poseidon_tpu.cluster import ClusterState, Machine, Task, TaskPhase
+from poseidon_tpu.graph.builder import ArcKind, FlowGraphBuilder
+from poseidon_tpu.models import (
+    COST_CAP,
+    COST_MODELS,
+    COST_MODEL_SELECTORS,
+    KnowledgeBase,
+    MachineSample,
+    TaskSample,
+    build_cost_inputs,
+    get_cost_model,
+    quincy_cost,
+    octopus_cost,
+)
+from poseidon_tpu.ops.ssp import solve_ssp, solution_cost
+from poseidon_tpu.oracle.oracle import solve_oracle
+
+
+def small_cluster(n_machines=4, n_tasks=12, prefs=True, seed=0):
+    rng = np.random.default_rng(seed)
+    machines = [
+        Machine(name=f"m{i}", rack=f"r{i // 2}", max_tasks=4)
+        for i in range(n_machines)
+    ]
+    tasks = []
+    for j in range(n_tasks):
+        data = {}
+        if prefs:
+            data = {f"m{rng.integers(0, n_machines)}": int(rng.integers(10, 90))}
+        tasks.append(
+            Task(uid=f"p{j}", job=f"j{j % 3}", data_prefs=data,
+                 cpu_request=0.25, memory_request_kb=1 << 18)
+        )
+    return ClusterState(machines=machines, tasks=tasks)
+
+
+def priced(cluster, model_name, kb=None):
+    net, meta = FlowGraphBuilder().build(cluster)
+    machines = [m.name for m in cluster.machines]
+    kwargs = {}
+    if kb is not None:
+        kwargs["machine_load"] = kb.machine_load(machines)
+        kwargs["machine_mem_free"] = kb.machine_mem_free(machines)
+    inputs = build_cost_inputs(
+        net, meta,
+        task_cpu_milli=np.array(
+            [int(t.cpu_request * 1000) for t in cluster.pending()]),
+        task_mem_kb=np.array(
+            [t.memory_request_kb for t in cluster.pending()]),
+        **kwargs,
+    )
+    cost = get_cost_model(model_name)(inputs)
+    return net.with_costs(cost), meta, inputs
+
+
+class TestRegistry:
+    def test_names_and_selectors(self):
+        for name in COST_MODELS:
+            assert get_cost_model(name) is COST_MODELS[name]
+        for sel, name in COST_MODEL_SELECTORS.items():
+            assert get_cost_model(sel) is COST_MODELS[name]
+        # the reference's shipped config selects 6 = load balancing
+        assert COST_MODEL_SELECTORS[6] == "octopus"
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError):
+            get_cost_model("nope")
+        with pytest.raises(KeyError):
+            get_cost_model(99)
+
+
+class TestPricingSemantics:
+    @pytest.mark.parametrize("name", sorted(COST_MODELS))
+    def test_bounds_and_padding(self, name):
+        net, meta, inputs = priced(small_cluster(), name)
+        c = np.asarray(net.cost)
+        assert c.min() >= 0 and c.max() <= COST_CAP
+        assert (c[meta.n_arcs:] == 0).all(), "padding arcs must cost 0"
+
+    def test_quincy_prefers_local_machine(self):
+        cluster = small_cluster(prefs=True)
+        net, meta, inputs = priced(cluster, "quincy")
+        c = np.asarray(net.cost)[: meta.n_arcs]
+        pref = meta.arc_kind == int(ArcKind.TASK_TO_MACHINE)
+        wild = meta.arc_kind == int(ArcKind.TASK_TO_CLUSTER)
+        # every pref arc is cheaper than the same task's wildcard arc
+        for ti in np.unique(meta.arc_task[pref]):
+            p = c[pref & (meta.arc_task == ti)].min()
+            w = c[wild & (meta.arc_task == ti)].min()
+            assert p < w
+
+    def test_quincy_wait_raises_unsched_cost(self):
+        cluster = small_cluster()
+        impatient = ClusterState(
+            machines=cluster.machines,
+            tasks=[Task(uid=t.uid, job=t.job, data_prefs=t.data_prefs,
+                        wait_rounds=7) for t in cluster.tasks],
+        )
+        _, meta0, i0 = priced(cluster, "quincy")
+        _, meta7, i7 = priced(impatient, "quincy")
+        c0 = np.asarray(quincy_cost(i0))[: meta0.n_arcs]
+        c7 = np.asarray(quincy_cost(i7))[: meta7.n_arcs]
+        uns = meta0.arc_kind == int(ArcKind.TASK_TO_UNSCHED)
+        assert (c7[uns] > c0[uns]).all()
+
+    def test_octopus_prices_busy_machines_up(self):
+        cluster = small_cluster(prefs=False)
+        kb = KnowledgeBase()
+        for i, m in enumerate(cluster.machines):
+            # m0 idle ... m3 slammed
+            kb.add_machine_sample(
+                m.name, MachineSample(cpu_idle=1.0 - i / 3.0,
+                                      mem_free_frac=1.0))
+        net, meta, inputs = priced(cluster, "octopus", kb=kb)
+        c = np.asarray(net.cost)[: meta.n_arcs]
+        sink = meta.arc_kind == int(ArcKind.MACHINE_TO_SINK)
+        per_machine = {meta.arc_machine[i]: c[i]
+                       for i in np.where(sink)[0]}
+        assert per_machine[0] < per_machine[3]
+
+    def test_knowledge_base_ring_bound(self):
+        kb = KnowledgeBase(queue_size=4)
+        for v in [0.0, 0.0, 0.0, 0.0, 1.0, 1.0, 1.0, 1.0]:
+            kb.add_machine_sample("m", MachineSample(cpu_idle=v,
+                                                     mem_free_frac=v))
+        # ring of 4 keeps only the last four samples
+        assert kb.machine_cpu_idle(["m"])[0] == pytest.approx(1.0)
+        kb.add_task_sample("t", TaskSample(cpu_usage=0.5, mem_usage_kb=10))
+        assert kb.task_cpu_usage(["t"])[0] == pytest.approx(0.5)
+
+
+class TestEndToEnd:
+    @pytest.mark.parametrize("name", ["trivial", "quincy", "octopus"])
+    def test_model_priced_solve_matches_oracle(self, name):
+        cluster = small_cluster(n_machines=4, n_tasks=10)
+        kb = KnowledgeBase()
+        for i, m in enumerate(cluster.machines):
+            kb.add_machine_sample(
+                m.name, MachineSample(cpu_idle=0.9 - 0.2 * i,
+                                      mem_free_frac=0.8))
+        net, meta, _ = priced(cluster, name, kb=kb)
+        res = solve_ssp(net)
+        assert bool(res.feasible)
+        oracle = solve_oracle(net, "cost_scaling")
+        assert solution_cost(net, res) == oracle.cost
+
+    def test_vmap_what_if_over_load_perturbations(self):
+        """BASELINE config 5 seam: one compiled program prices B scenarios."""
+        cluster = small_cluster(prefs=False)
+        net, meta, inputs = priced(cluster, "octopus")
+        B = 8
+        loads = jnp.linspace(0.0, 1.0, B)[:, None] * jnp.ones(
+            (B, inputs.machine_load.shape[0]))
+
+        @jax.jit
+        def batch_costs(load):
+            import dataclasses as dc
+            return jax.vmap(
+                lambda ld: octopus_cost(dc.replace(inputs, machine_load=ld))
+            )(load)
+
+        costs = np.asarray(batch_costs(loads))
+        assert costs.shape[0] == B
+        sink = np.asarray(inputs.kind) == int(ArcKind.MACHINE_TO_SINK)
+        # heavier load scenario -> uniformly pricier machine arcs
+        assert (costs[-1][sink] >= costs[0][sink]).all()
+        assert (costs[-1][sink] > costs[0][sink]).any()

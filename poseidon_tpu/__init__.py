@@ -18,12 +18,3 @@ Layers (SURVEY.md section 7):
 """
 
 __version__ = "0.1.0"
-
-# The cost-scaling solver keeps node prices in the n-scaled cost domain,
-# whose worst-case magnitude exceeds int32; exactness therefore needs
-# int64 on device (emulated but supported on TPU). All framework arrays
-# declare explicit dtypes, so enabling x64 does not change any other
-# behavior.
-import jax as _jax
-
-_jax.config.update("jax_enable_x64", True)

@@ -60,7 +60,11 @@ def read_dimacs(text: str) -> FlowNetwork:
         elif parts[0] == "n":
             if supply is None:
                 raise ValueError("n line before p line")
-            supply[int(parts[1]) - 1] = int(parts[2])
+            v = int(parts[1])
+            if not 1 <= v <= n_nodes:
+                # without this, node id 0 would alias supply[-1] silently
+                raise ValueError(f"node id {v} out of range 1..{n_nodes}")
+            supply[v - 1] = int(parts[2])
         elif parts[0] == "a":
             if int(parts[3]) != 0:
                 raise ValueError("nonzero lower bounds unsupported")

@@ -50,7 +50,6 @@ import numpy as np
 from poseidon_tpu.graph.network import FlowNetwork
 
 I64 = jnp.int64
-NEG_INF = jnp.int64(-(2**62))
 
 
 @jax.tree_util.register_dataclass
@@ -215,7 +214,14 @@ def _solve(net: FlowNetwork, max_sweeps: int, alpha: int,
             (d0, jnp.bool_(True), jnp.int32(0)),
         )
         converged = ~changed
-        k = jnp.where(d < INF_K, d, 0)
+        # Nodes with no residual path to a deficit must drop BELOW every
+        # reachable node: k = 0 would keep their price, which can push a
+        # residual arc's reduced cost under -eps and break the
+        # eps-optimality invariant the final-phase exactness proof needs.
+        # A uniform k_max + 1 keeps their relative prices (a uniform shift
+        # leaves reduced costs among them unchanged).
+        k_max = jnp.max(jnp.where(d < INF_K, d, 0))
+        k = jnp.where(d < INF_K, d, k_max + 1)
         price = jnp.where(converged, price - k * eps, price)
         return price
 
@@ -305,7 +311,27 @@ def solve_cost_scaling(
     if max_sweeps is None:
         # generous: phases * O(per-phase sweeps); sized empirically
         max_sweeps = 200 * (net.num_node_slots.bit_length() + 8) * 8
-    return _solve(net, max_sweeps, alpha, sweeps_per_update)
+    # Excess accumulators are int32: a node's excess after the saturation
+    # step is bounded by its incident residual capacity (plus its supply
+    # arc), which must not wrap.
+    cap = np.asarray(net.cap, dtype=np.int64)
+    sup = np.asarray(net.supply, dtype=np.int64)
+    N = net.num_node_slots
+    incident = np.zeros(N, np.int64)
+    np.add.at(incident, np.asarray(net.src), cap)
+    np.add.at(incident, np.asarray(net.dst), cap)
+    incident += np.abs(sup)
+    worst = max(int(incident.max(initial=0)), int(np.abs(sup).sum()))
+    if worst >= 2**30:
+        raise ValueError(
+            f"per-node incident capacity {worst} can wrap the int32 "
+            "excess accumulator; rescale capacities"
+        )
+    # Prices live in the n-scaled cost domain whose worst case exceeds
+    # int32; x64 is scoped to this solve rather than flipped globally at
+    # package import (which would silently change caller dtypes).
+    with jax.enable_x64(True):
+        return _solve(net, max_sweeps, alpha, sweeps_per_update)
 
 
 def solution_cost(net: FlowNetwork, result: CostScalingResult) -> int:

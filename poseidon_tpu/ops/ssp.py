@@ -180,7 +180,10 @@ def solve_ssp(net: FlowNetwork, *, max_paths: int | None = None) -> SolveResult:
     < wanted on return) means the remaining supplies are infeasible.
     """
     maxc = int(np.abs(np.asarray(net.cost)).max()) if net.num_arc_slots else 0
-    if maxc * (net.num_node_slots + 2) >= 2**30:
+    # Worst finite intermediate: cand = dist + rc where dist <= maxc*NN,
+    # |rc| <= maxc*(2*NN + 1) (cost plus two potentials) — so the sum must
+    # stay under INF = 2**30 for the masked arithmetic to be exact.
+    if maxc * 3 * (net.num_node_slots + 3) >= 2**30:
         raise ValueError(
             f"cost magnitude {maxc} too large for exact int32 SSP on "
             f"{net.num_node_slots} node slots"

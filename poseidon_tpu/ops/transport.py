@@ -28,10 +28,10 @@ bounded end-of-final-phase fixup that releases abandoned priced slots and
 lets the market re-settle — mid-phase, assigned tasks never abandon slots
 because prices only rise).
 
-This file holds the instance extraction and the vectorized numpy
-reference implementation (also the CPU fallback); the Pallas TPU kernel
-lives in ops/transport_tpu.py and is differentially tested against this
-and against the C++ oracle.
+This file holds the instance extraction and the numpy reference
+implementation (the CPU correctness baseline for differential tests);
+the device kernel is the vectorized JAX auction in ops/transport_tpu.py,
+reached through the ``poseidon_tpu.solve_scheduling`` front door.
 """
 
 from __future__ import annotations
@@ -143,6 +143,8 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     d = np.full(M, INF, np.int64)
     for a in c2m:
         m = meta.arc_machine[a]
+        if m < 0 or arc_c2m[m] >= 0:
+            raise NotSchedulingShaped("duplicate or unlabeled cluster->machine")
         arc_c2m[m] = a
         d[m] = cost[a] + g[m]
         if cap[a] != slots[m]:
@@ -154,6 +156,8 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     rack_of = np.full(M, -1, np.int32)
     for a in r2m:
         m = meta.arc_machine[a]
+        if m < 0 or arc_r2m[m] >= 0:
+            raise NotSchedulingShaped("duplicate or unlabeled rack->machine")
         arc_r2m[m] = a
         ra[m] = cost[a] + g[m]
         rack_of[m] = meta.arc_rack[a]
@@ -183,6 +187,8 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
         node = int(host["dst"][a])
         if t < 0 or node not in node_to_job:
             raise NotSchedulingShaped("unsched arc without aggregator drain")
+        if arc_unsched[t] >= 0:
+            raise NotSchedulingShaped("duplicate task->unsched arc")
         j = node_to_job[node]
         arc_unsched[t] = a
         arc_u2s[t] = unsched_sink_arc[j]
@@ -197,6 +203,8 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     w = np.full(T, INF, np.int64)
     for a in t2c:
         t = meta.arc_task[a]
+        if t < 0 or arc_cluster[t] >= 0:
+            raise NotSchedulingShaped("duplicate or unlabeled task->cluster")
         arc_cluster[t] = a
         w[t] = cost[a]
     if T and (arc_cluster < 0).any():

@@ -1,0 +1,104 @@
+"""Synthetic cluster generators for the BASELINE benchmark ladder.
+
+The reference publishes no benchmarks (SURVEY.md section 6); the driver's
+north star is the BASELINE.md config ladder (Trivial 10/100 -> Quincy
+1k/10k -> CoCo 1k -> trace replay -> vmap x64). These generators produce
+``ClusterState`` instances at those scales with realistic structure: racks
+of ~32 machines, multi-task jobs, Zipf-ish data-locality preferences, and
+a fraction of already-running tasks occupying slots.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from poseidon_tpu.cluster import ClusterState, Machine, Task, TaskPhase
+
+
+def make_synthetic_cluster(
+    n_machines: int,
+    n_tasks: int,
+    *,
+    seed: int = 0,
+    machines_per_rack: int = 32,
+    max_tasks_per_machine: int = 10,
+    prefs_per_task: int = 2,
+    tasks_per_job: int = 8,
+    running_fraction: float = 0.0,
+) -> ClusterState:
+    """A synthetic cluster shaped like the BASELINE configs.
+
+    ``running_fraction`` of the tasks are marked RUNNING and bound to a
+    machine (consuming slots via the builder's discounting); the rest are
+    PENDING and carry ``prefs_per_task`` data-locality preferences drawn
+    with rack affinity (a task's preferred machines cluster in one rack,
+    like Quincy input-data placement).
+    """
+    rng = np.random.default_rng(seed)
+    n_racks = max(1, (n_machines + machines_per_rack - 1) // machines_per_rack)
+    machines = [
+        Machine(
+            name=f"m{i:05d}",
+            rack=f"rack{i % n_racks:03d}",
+            cpu_capacity=float(rng.choice([8, 16, 32])),
+            cpu_allocatable=float(rng.choice([6, 12, 24])),
+            memory_capacity_kb=int(rng.choice([1, 2, 4])) << 24,
+            memory_allocatable_kb=int(rng.choice([1, 2, 4])) << 23,
+            max_tasks=max_tasks_per_machine,
+        )
+        for i in range(n_machines)
+    ]
+
+    n_running = int(n_tasks * running_fraction)
+    tasks: list[Task] = []
+    for j in range(n_tasks):
+        running = j < n_running
+        prefs: dict[str, int] = {}
+        if not running and prefs_per_task:
+            # rack-affine preferences: most of a task's input lives in one
+            # rack, so its preferred machines (and one rack pref) do too
+            home = int(rng.integers(0, n_racks))
+            in_home = np.flatnonzero(
+                np.arange(n_machines) % n_racks == home
+            )
+            k = min(prefs_per_task, len(in_home))
+            for m in rng.choice(in_home, size=k, replace=False):
+                prefs[machines[int(m)].name] = int(rng.integers(20, 200))
+            if rng.random() < 0.3:
+                prefs[f"rack{home:03d}"] = int(rng.integers(10, 100))
+        tasks.append(
+            Task(
+                uid=f"pod-{j:06d}",
+                job=f"job-{j // tasks_per_job:05d}",
+                cpu_request=float(rng.choice([0.1, 0.25, 0.5, 1.0])),
+                memory_request_kb=int(rng.choice([1, 2, 8])) << 18,
+                phase=TaskPhase.RUNNING if running else TaskPhase.PENDING,
+                machine=(
+                    machines[int(rng.integers(0, n_machines))].name
+                    if running else ""
+                ),
+                data_prefs=prefs,
+                wait_rounds=int(rng.integers(0, 4)),
+            )
+        )
+    return ClusterState(machines=machines, tasks=tasks)
+
+
+# ---- the BASELINE.md ladder ----
+
+def config1_trivial_small(seed: int = 0) -> ClusterState:
+    """BASELINE config 1: Trivial model, 10 nodes / 100 pods."""
+    return make_synthetic_cluster(10, 100, seed=seed, prefs_per_task=0,
+                                  max_tasks_per_machine=12)
+
+
+def config2_quincy_flagship(seed: int = 0) -> ClusterState:
+    """BASELINE config 2: Quincy, 1k nodes / 10k pods (the headline)."""
+    return make_synthetic_cluster(1000, 10_000, seed=seed,
+                                  prefs_per_task=2)
+
+
+def config3_coco(seed: int = 0) -> ClusterState:
+    """BASELINE config 3: CoCo interference, 1k nodes."""
+    return make_synthetic_cluster(1000, 8000, seed=seed, prefs_per_task=1,
+                                  running_fraction=0.2)

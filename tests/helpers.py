@@ -1,0 +1,60 @@
+"""Shared fixtures: random clusters + pricing, used across test modules."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from poseidon_tpu.cluster import ClusterState
+from poseidon_tpu.graph.builder import FlowGraphBuilder, GraphMeta
+from poseidon_tpu.graph.network import FlowNetwork
+from poseidon_tpu.models import build_cost_inputs, get_cost_model
+from poseidon_tpu.synth import make_synthetic_cluster
+
+
+def random_cluster(
+    rng: np.random.Generator, n_machines: int, n_tasks: int
+) -> ClusterState:
+    """A randomized small cluster with racks, prefs, jobs, running tasks."""
+    return make_synthetic_cluster(
+        n_machines,
+        n_tasks,
+        seed=int(rng.integers(0, 2**31)),
+        machines_per_rack=int(rng.integers(2, max(3, n_machines))),
+        max_tasks_per_machine=int(rng.integers(1, 6)),
+        prefs_per_task=int(rng.integers(0, 4)),
+        tasks_per_job=int(rng.integers(1, 6)),
+        running_fraction=float(rng.choice([0.0, 0.2])),
+    )
+
+
+def price(
+    net: FlowNetwork,
+    meta: GraphMeta,
+    model: str,
+    cluster: ClusterState | None = None,
+    **cost_input_kwargs,
+) -> FlowNetwork:
+    """Price a built network with a named cost model."""
+    if cluster is not None:
+        pending = cluster.pending()
+        cost_input_kwargs.setdefault(
+            "task_cpu_milli",
+            np.array([int(t.cpu_request * 1000) for t in pending]),
+        )
+        cost_input_kwargs.setdefault(
+            "task_mem_kb", np.array([t.memory_request_kb for t in pending])
+        )
+    inputs = build_cost_inputs(net, meta, **cost_input_kwargs)
+    return net.with_costs(get_cost_model(model)(inputs))
+
+
+def build_priced(
+    rng: np.random.Generator,
+    n_machines: int,
+    n_tasks: int,
+    model: str = "quincy",
+):
+    """random cluster -> (priced net, meta, cluster)."""
+    cluster = random_cluster(rng, n_machines, n_tasks)
+    net, meta = FlowGraphBuilder().build(cluster)
+    return price(net, meta, model, cluster), meta, cluster

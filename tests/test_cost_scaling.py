@@ -120,9 +120,10 @@ class TestWhatIfBatching:
         # zero the padding cost slots to stay consistent
         costs[:, int(base.n_arcs):] = 0
 
-        batched = jax.vmap(
-            lambda c: _solve(base.with_costs(c), 20000, 8)
-        )(jnp.asarray(costs))
+        with jax.enable_x64(True):
+            batched = jax.vmap(
+                lambda c: _solve(base.with_costs(c), 20000, 8)
+            )(jnp.asarray(costs))
         for k in range(K):
             net_k = base.with_costs(jnp.asarray(costs[k]))
             oracle = solve_oracle(net_k, "cost_scaling")

@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""BASELINE-ladder benchmark harness.
+
+Runs the BASELINE.md config ladder end to end — synthetic cluster →
+flow-graph build → cost-model pricing → transportation extract → TPU
+solve → decompose — timing every phase separately (the SURVEY §5.1
+per-phase observability requirement), and cross-checks every solve
+against the C++ CPU oracle (the cs2/flowlessly-class baseline at the
+reference's solver seam, deploy/poseidon.cfg:8-10).
+
+Prints ONE JSON line to stdout:
+
+    {"metric": "...", "value": N, "unit": "ms", "vs_baseline": N, ...}
+
+where the headline metric is the warm p50 device solve time on the
+BASELINE config-2 flagship (Quincy, 1k machines / 10k pods) and
+``vs_baseline`` is the speedup factor over the C++ oracle on the same
+instance (target: value < 50 ms, vs_baseline >= 20, BASELINE.md).
+Per-config detail rows (all phases, costs, convergence) ride along in
+the same JSON object under "configs"; human-readable progress goes to
+stderr so stdout stays machine-parseable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+import traceback
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _ms(samples: list[float]) -> float:
+    if not samples:
+        return -1.0
+    return round(statistics.median(samples) * 1000, 3)
+
+
+def bench_config(
+    name: str,
+    cluster,
+    model: str,
+    *,
+    solve_reps: int,
+    oracle_reps: int,
+    what_if: int = 0,
+) -> dict:
+    """Time one ladder config end to end; returns the detail row."""
+    from poseidon_tpu.graph.builder import FlowGraphBuilder
+    from poseidon_tpu.graph.decompose import extract_placements
+    from poseidon_tpu.models import build_cost_inputs, get_cost_model
+    from poseidon_tpu.ops.transport import extract_instance, flows_from_assignment
+    from poseidon_tpu.ops.transport_tpu import solve_transport_tpu
+    from poseidon_tpu.oracle import solve_oracle
+
+    row: dict = {"config": name, "model": model}
+    t0 = time.perf_counter()
+    net, meta = FlowGraphBuilder().build(cluster)
+    t1 = time.perf_counter()
+    row["build_ms"] = round((t1 - t0) * 1000, 3)
+    row["nodes"], row["arcs"] = int(net.n_nodes), int(net.n_arcs)
+
+    pending = cluster.pending()
+    inputs = build_cost_inputs(
+        net,
+        meta,
+        task_cpu_milli=np.array([int(t.cpu_request * 1000) for t in pending]),
+        task_mem_kb=np.array([t.memory_request_kb for t in pending]),
+    )
+    cost_fn = get_cost_model(model)
+    costs = np.asarray(cost_fn(inputs))  # warm the jit before timing
+    t2 = time.perf_counter()
+    prices = []
+    for _ in range(max(solve_reps, 2)):
+        ta = time.perf_counter()
+        costs = np.asarray(cost_fn(inputs))
+        prices.append(time.perf_counter() - ta)
+    row["price_ms"] = _ms(prices)
+    net = net.with_costs(costs)
+
+    t3 = time.perf_counter()
+    inst = extract_instance(net, meta)
+    row["extract_ms"] = round((time.perf_counter() - t3) * 1000, 3)
+    row["tasks"], row["machines"] = inst.n_tasks, inst.n_machines
+
+    # cold solve (includes compile) then warm p50
+    t4 = time.perf_counter()
+    res, pr = solve_transport_tpu(inst)
+    row["solve_cold_ms"] = round((time.perf_counter() - t4) * 1000, 3)
+    solves = []
+    for _ in range(solve_reps):
+        ta = time.perf_counter()
+        res, pr = solve_transport_tpu(inst)
+        solves.append(time.perf_counter() - ta)
+    row["solve_p50_ms"] = _ms(solves)
+    row["rounds"], row["phases"] = res.rounds, res.phases
+    row["converged"] = bool(res.converged)
+    row["cost"] = int(res.cost)
+
+    # warm-start (incremental re-solve) path: same instance, prior prices
+    warms = []
+    for _ in range(solve_reps):
+        ta = time.perf_counter()
+        res_w, _ = solve_transport_tpu(inst, warm_prices=pr)
+        warms.append(time.perf_counter() - ta)
+    row["solve_warm_ms"] = _ms(warms)
+    row["warm_cost_match"] = bool(res_w.cost == res.cost)
+
+    t5 = time.perf_counter()
+    flows = flows_from_assignment(inst, res, int(net.n_arcs))
+    placements = extract_placements(
+        flows, meta, np.asarray(net.src), np.asarray(net.dst)
+    )
+    row["decompose_ms"] = round((time.perf_counter() - t5) * 1000, 3)
+    row["placed"] = len(placements)
+
+    oracles = []
+    oc = None
+    for _ in range(max(oracle_reps, 1)):
+        ta = time.perf_counter()
+        oc = solve_oracle(net, algorithm="cost_scaling")
+        oracles.append(time.perf_counter() - ta)
+    row["oracle_ms"] = _ms(oracles)
+    row["oracle_cost"] = int(oc.cost)
+    row["exact"] = bool(res.cost == oc.cost)
+    if row["solve_p50_ms"] > 0:
+        row["speedup_vs_oracle"] = round(
+            row["oracle_ms"] / row["solve_p50_ms"], 2
+        )
+        row["pods_per_sec"] = round(
+            inst.n_tasks / (row["solve_p50_ms"] / 1000), 1
+        )
+
+    if what_if:
+        try:
+            from poseidon_tpu.ops.batch import solve_what_if
+        except ImportError:
+            row["what_if_skipped"] = "ops.batch not available"
+            return row
+        batch = solve_what_if(inst, n_variants=what_if, seed=7)
+        t6 = time.perf_counter()
+        batch = solve_what_if(inst, n_variants=what_if, seed=7)
+        dt = time.perf_counter() - t6
+        row["what_if_n"] = what_if
+        row["what_if_total_ms"] = round(dt * 1000, 3)
+        row["what_if_per_instance_ms"] = round(dt * 1000 / what_if, 3)
+        row["what_if_all_converged"] = bool(all(batch.converged))
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--configs",
+        default="1,2,3,5",
+        help="comma list of BASELINE config numbers to run",
+    )
+    ap.add_argument("--solve-reps", type=int, default=5)
+    ap.add_argument("--oracle-reps", type=int, default=3)
+    args = ap.parse_args()
+    args.solve_reps = max(1, args.solve_reps)
+    args.oracle_reps = max(1, args.oracle_reps)
+    want = {int(x) for x in args.configs.split(",") if x}
+
+    import jax
+
+    from poseidon_tpu import synth
+
+    backend = jax.devices()[0]
+    log(f"bench: device = {backend}")
+
+    ladder = {
+        1: ("trivial_10n_100p", synth.config1_trivial_small, "trivial", 0),
+        2: ("quincy_1k_10k", synth.config2_quincy_flagship, "quincy", 0),
+        3: ("coco_1k_8k", synth.config3_coco, "coco", 0),
+        5: ("whatif_x64", synth.config1_trivial_small, "quincy", 64),
+    }
+
+    rows = []
+    for num in sorted(want):
+        if num not in ladder:
+            continue
+        name, gen, model, what_if = ladder[num]
+        log(f"bench: running config {num} ({name}, {model}) ...")
+        try:
+            row = bench_config(
+                name,
+                gen(),
+                model,
+                solve_reps=args.solve_reps,
+                oracle_reps=args.oracle_reps,
+                what_if=what_if,
+            )
+            row["config_num"] = num
+            rows.append(row)
+            log(f"bench: config {num} done: {json.dumps(row)}")
+        except Exception:
+            log(f"bench: config {num} FAILED:\n{traceback.format_exc()}")
+            rows.append({"config": name, "config_num": num, "error": True})
+
+    flagship = next(
+        (r for r in rows if r.get("config_num") == 2 and not r.get("error")),
+        None,
+    )
+    if flagship is not None:
+        headline = {
+            "metric": "quincy_1k10k_warm_solve_p50",
+            "value": flagship["solve_warm_ms"],
+            "unit": "ms",
+            "vs_baseline": round(
+                flagship["oracle_ms"] / flagship["solve_warm_ms"], 2
+            ),
+            "exact": flagship["exact"],
+            "converged": flagship["converged"],
+            "device": str(backend),
+            "configs": rows,
+        }
+    else:
+        fallback = next((r for r in rows if not r.get("error")), None)
+        headline = {
+            "metric": (
+                f"{fallback['config']}_warm_solve_p50"
+                if fallback
+                else "no_config_completed"
+            ),
+            "value": fallback["solve_warm_ms"] if fallback else -1,
+            "unit": "ms",
+            "vs_baseline": (
+                round(fallback["oracle_ms"] / fallback["solve_warm_ms"], 2)
+                if fallback
+                else 0
+            ),
+            "configs": rows,
+        }
+    print(json.dumps(headline), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

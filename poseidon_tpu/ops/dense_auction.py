@@ -1,0 +1,747 @@
+"""Dense class-price transportation auction — the TPU production solver.
+
+The builder taxonomy collapses every scheduling graph to a transportation
+problem (``ops/transport.py:extract_instance``): T tasks each pick one of
+M machines (capacity ``slots[m]``) or their own unscheduled route. This
+kernel solves that form exactly, entirely on device, as ONE jit-compiled
+program over dense ``[T, M]`` int32 tables — the TPU-native replacement
+for the reference's per-round fork/exec of a cs2/Flowlessly binary
+(reference deploy/poseidon.cfg:8-10, README.md:21; solver seam surface at
+src/firmament/scheduler_bridge.cc:170-172).
+
+Why dense: at the BASELINE flagship scale (1k machines x 10k pods) the
+full cost matrix is ~64 MB of int32 — a few hundred microseconds per
+sweep at HBM bandwidth, far below one auction round of the sparse
+worklist algorithms the reference's solvers use on CPU. Padding to
+power-of-two buckets keeps shapes static so XLA compiles once.
+
+Algorithm: Bertsekas-Castanon style eps-scaling auction for the
+transportation problem, Jacobi (all-bidders-at-once) rounds, with one
+price per machine *class* (slots of a machine are interchangeable, so
+the LP dual has one multiplier per machine — not per slot):
+
+- state is just ``asg[T]`` (machine / UNSCHED / -1) and ``lvl[T]`` (the
+  price each holder committed); machine prices are DERIVED: p[m] = the
+  weakest holder's level if m is full, else 0. A machine with free
+  capacity therefore always asks 0 — the "stranded price on an empty
+  slot" failure mode of slot-priced auctions cannot be represented.
+- each round, every unassigned task computes its best and second-best
+  option over {all machines, unsched} at current prices and bids
+  ``b2 + eps - c[t, m*]`` on its best machine (so it tolerates paying up
+  to eps more than its runner-up). Holders and bids then meet in ONE
+  lexicographic sort by (machine, -level, task): the top ``slots[m]``
+  entries per machine hold, everyone else is released. A rejected bid
+  means the machine's derived price rose by >= eps, so rounds make
+  strict dual progress; prices only rise within a phase, which preserves
+  eps-complementary-slackness for every standing assignment.
+- phases shrink eps by ``alpha``; each phase boundary releases the
+  assignments that violate the tighter eps and re-runs. Costs are
+  pre-scaled by (T + 1), so the final eps = 1 phase pins the exact
+  integer optimum (the classic scaling argument: eps-CS with eps < 1/T
+  in unscaled terms admits no improving exchange).
+- exactness is certified *in the kernel*: the primal cost minus the
+  transportation-LP dual value (at the derived prices) must be < scale.
+  The gap and a converged flag come back with the result; a blown fuse
+  surfaces as converged=False so callers can fall back. No silent wrong
+  answers.
+
+Everything — instance densification, the phase ladder, the certificate —
+runs in one ``jax.jit`` region with no host round-trips (the axon-tunnel
+environment charges ~100 ms per fresh host<->device transfer, so the
+solve-time budget allows exactly one upload batch per instance and one
+download batch per result).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poseidon_tpu.graph.network import pad_bucket
+from poseidon_tpu.ops.transport import (
+    CH_CLUSTER,
+    CH_PREF,
+    CH_UNSCHED,
+    TransportInstance,
+    TransportResult,
+)
+
+I32 = jnp.int32
+INF = np.int32(2**28)       # saturation cap; all finite values stay below
+_NPINF = np.int64(2**48)    # host INF used by TransportInstance
+MAX_SCALED_COST = 2**26     # guard: scaled costs must stay below this
+
+
+class CostDomainTooLarge(ValueError):
+    """Scaled costs exceed the int32 auction domain; use a fallback."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseInstance:
+    """Scaled, padded, device-resident dense transportation instance."""
+
+    c: jax.Array           # i32[Tp, Mp] cost of machine m for task t (INF)
+    u: jax.Array           # i32[Tp] unsched route cost (0 on padding)
+    w: jax.Array           # i32[Tp] generic (cluster) channel task cost
+    dgen: jax.Array        # i32[Mp] generic channel machine route cost
+    s: jax.Array           # i32[Mp] slot capacity (0 on padding)
+    task_valid: jax.Array  # bool[Tp]
+    scale: jax.Array       # i32 scalar = n_tasks + 1
+    cmax: jax.Array        # i32 scalar: max finite scaled cost
+    smax: int              # static: max slots of any machine
+
+
+jax.tree_util.register_dataclass(
+    DenseInstance,
+    data_fields=["c", "u", "w", "dgen", "s", "task_valid", "scale", "cmax"],
+    meta_fields=["smax"],
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DenseState:
+    """Device-resident solver state; feed back in for warm re-solves."""
+
+    asg: jax.Array         # i32[Tp]: -1 | machine | Mp (= unsched)
+    lvl: jax.Array         # i32[Tp] committed price
+    floor: jax.Array       # i32[Mp] machine reserve price
+    gap: jax.Array         # i64 scalar: primal - dual (scaled)
+    converged: jax.Array   # bool scalar
+    rounds: jax.Array      # i32 scalar
+    phases: jax.Array      # i32 scalar
+
+
+def _sc(x: np.ndarray, scale: np.int64) -> np.ndarray:
+    v = np.asarray(x, np.int64)
+    return np.where(v >= _NPINF, np.int64(INF), v * scale).astype(np.int32)
+
+
+@partial(jax.jit, static_argnames=("n_prefs",))
+def _densify(
+    w, d, ra, rack_of, slots, pref_cost, pref_machine, pref_rack,
+    n_prefs: int,
+):
+    """Build the dense [Tp, Mp] cost table from the channel arrays."""
+    Mp = d.shape[0]
+    mids = jnp.arange(Mp, dtype=I32)
+    c = jnp.minimum(w[:, None] + d[None, :], INF)
+    for k in range(n_prefs):
+        pm = pref_machine[:, k]
+        pr = pref_rack[:, k]
+        pc = pref_cost[:, k]
+        hit_m = (pm[:, None] == mids[None, :]) & (pm[:, None] >= 0)
+        c = jnp.minimum(c, jnp.where(hit_m, pc[:, None], INF))
+        hit_r = (pr[:, None] == rack_of[None, :]) & (pr[:, None] >= 0)
+        rv = jnp.minimum(pc[:, None] + ra[None, :], INF)
+        c = jnp.minimum(c, jnp.where(hit_r, rv, INF))
+    c = jnp.where(slots[None, :] > 0, c, INF)
+    return c
+
+
+def build_dense_instance(inst: TransportInstance) -> DenseInstance:
+    """Scale + pad a host TransportInstance and densify it on device."""
+    T, M, P = inst.n_tasks, inst.n_machines, inst.max_prefs
+    Tp = pad_bucket(max(T, 1))
+    Mp = pad_bucket(max(M, 1))
+    scale = np.int64(T + 1)
+
+    cmax = 0
+    for arr in (inst.u, inst.w, inst.pref_cost, inst.d, inst.ra):
+        a = np.asarray(arr, np.int64)
+        fin = a[a < _NPINF]
+        if fin.size:
+            if (fin < 0).any():
+                raise ValueError("auction requires non-negative costs")
+            cmax = max(cmax, int(fin.max()))
+    # route costs add at most two finite legs before saturation
+    cmax_scaled = 2 * cmax * int(scale)
+    if cmax_scaled >= MAX_SCALED_COST:
+        raise CostDomainTooLarge(
+            f"scaled cost domain {cmax_scaled} exceeds int32 auction "
+            f"limit {MAX_SCALED_COST}"
+        )
+
+    def pad1(x, size, fill):
+        out = np.full(size, fill, np.int32)
+        v = np.asarray(x)
+        out[: v.shape[0]] = v
+        return out
+
+    def pad2(x, shape, fill):
+        out = np.full(shape, fill, np.int32)
+        v = np.asarray(x)
+        out[: v.shape[0], : v.shape[1]] = v
+        return out
+
+    u = pad1(_sc(inst.u, scale), Tp, 0)
+    w = pad1(_sc(inst.w, scale), Tp, INF)
+    d = pad1(_sc(inst.d, scale), Mp, INF)
+    ra = pad1(_sc(inst.ra, scale), Mp, INF)
+    rack_of = pad1(inst.rack_of, Mp, -1)
+    slots = pad1(inst.slots, Mp, 0)
+    if P:
+        pc = pad2(_sc(inst.pref_cost, scale), (Tp, P), INF)
+        pm = pad2(inst.pref_machine, (Tp, P), -1)
+        pr = pad2(inst.pref_rack, (Tp, P), -1)
+    else:
+        pc = np.full((Tp, 1), INF, np.int32)
+        pm = np.full((Tp, 1), -1, np.int32)
+        pr = np.full((Tp, 1), -1, np.int32)
+    task_valid = np.arange(Tp) < T
+
+    c = _densify(
+        jnp.asarray(w), jnp.asarray(d), jnp.asarray(ra),
+        jnp.asarray(rack_of), jnp.asarray(slots), jnp.asarray(pc),
+        jnp.asarray(pm), jnp.asarray(pr),
+        n_prefs=P,
+    )
+    return DenseInstance(
+        c=c,
+        u=jnp.asarray(u),
+        w=jnp.asarray(w),
+        dgen=jnp.asarray(d),
+        s=jnp.asarray(slots),
+        task_valid=jnp.asarray(task_valid),
+        scale=jnp.int32(scale),
+        cmax=jnp.int32(min(cmax_scaled, int(INF) - 1)),
+        smax=max(int(np.max(slots, initial=0)), 1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+def _ask_prices(dev: DenseInstance, asg, lvl, floor):
+    """Per-machine ask price and fullness.
+
+    A full machine asks its weakest holder's level; a machine with free
+    capacity asks its reserve ``floor`` (NOT zero: a transiently-freed
+    machine advertising 0 makes every holder elsewhere an eps-CS
+    violator at the next phase boundary, collapsing the dual and
+    re-running the whole price war — measured as a 55k-round stall).
+    Floors start at the analytic clearing prices and only fall, via the
+    reverse/deflation step; the final fixpoint drives free machines'
+    floors to 0 so the certificate's complementary slackness is exact.
+    """
+    Mp = dev.s.shape[0]
+    on_machine = (asg >= 0) & (asg < Mp)
+    seg = jnp.where(on_machine, asg, Mp)
+    minlvl = jax.ops.segment_min(
+        jnp.where(on_machine, lvl, INF), seg, num_segments=Mp + 1
+    )[:Mp]
+    cnt = jax.ops.segment_sum(
+        on_machine.astype(I32), seg, num_segments=Mp + 1
+    )[:Mp]
+    full = cnt >= dev.s
+    p = jnp.where(full, jnp.minimum(minlvl, INF), floor)
+    return jnp.where(dev.s > 0, p, INF), full
+
+
+def _task_options(dev: DenseInstance, p):
+    """Per-task best/second-best machine values at prices p."""
+    v = jnp.minimum(dev.c + p[None, :], INF)
+    b1v = jnp.min(v, axis=1)
+    m1 = jnp.argmin(v, axis=1).astype(I32)
+    masked = jnp.where(
+        jnp.arange(v.shape[1], dtype=I32)[None, :] == m1[:, None], INF, v
+    )
+    v2 = jnp.min(masked, axis=1)
+    return b1v, m1, v2
+
+
+def _theta_clearing(dev: DenseInstance):
+    """Closed-form equilibrium of the generic seat market.
+
+    In the generic (cluster) channel every seat of machine m is the same
+    good delivered at cost d_m, every task's willingness to pay is
+    y_t = u_t - w_t, and the market clears at a single delivered price
+    theta* — the least theta where cumulative capacity of seats with
+    d <= theta covers the demand #{y > theta} (supply is monotone up,
+    demand monotone down). The equilibrium prices lam_m =
+    max(0, theta* - d_m) and the rank-matched assignment satisfy exact
+    CS for the generic-only problem, so the auction that follows only
+    has to repair the sparse pref-arc perturbations — this is what kills
+    the Omega(u_range / eps) serial price war a cold auction would need
+    to discover "who drops out" (measured: 55k+ rounds on a 48-task
+    instance without it).
+
+    Returns (asg0, lvl0, lam, theta)."""
+    Tp, Mp = dev.c.shape
+    UNS = Mp
+    y = jnp.where(dev.task_valid, dev.u - dev.w, jnp.int32(-INF))
+    d_eff = jnp.where(dev.s > 0, dev.dgen, INF)
+    # machines sorted by generic route cost; cumulative seat supply
+    sd, sdm, scap = jax.lax.sort(
+        (d_eff, jnp.arange(Mp, dtype=I32), dev.s), num_keys=2
+    )
+    cumcap = jnp.cumsum(jnp.where(sd < INF, scap, 0))
+    y_sorted = jnp.sort(y)
+    cands = jnp.concatenate([sd, y])
+    supply = jnp.where(
+        jnp.searchsorted(sd, cands, side="right") > 0,
+        cumcap[jnp.maximum(
+            jnp.searchsorted(sd, cands, side="right") - 1, 0)],
+        0,
+    )
+    demand = Tp - jnp.searchsorted(y_sorted, cands, side="right")
+    feasible = supply >= demand
+    theta = jnp.min(jnp.where(feasible, cands, INF))
+    # seat up to capacity among WEAKLY willing tasks (y >= theta): tasks
+    # tied at the margin are indifferent, and seating them is what keeps
+    # every machine with lam > 0 full — a partially-full machine forgets
+    # its analytic price (derived p = 0) and re-ignites the price war
+    idx_t = jnp.minimum(
+        jnp.maximum(jnp.searchsorted(sd, theta, side="right") - 1, 0),
+        Mp - 1,
+    )
+    sup_theta = jnp.where(
+        jnp.searchsorted(sd, theta, side="right") > 0, cumcap[idx_t], 0
+    )
+    k = jnp.minimum(
+        sup_theta, jnp.sum((y >= theta) & dev.task_valid)
+    )
+    # rank tasks by willingness (desc, tid asc); top-k get seats in
+    # cheapest-first order via the cumulative capacity boundaries
+    _, rt = jax.lax.sort((-y, jnp.arange(Tp, dtype=I32)), num_keys=1)
+    rank = jnp.zeros(Tp, I32).at[rt].set(jnp.arange(Tp, dtype=I32))
+    seat_machine = sdm[
+        jnp.minimum(
+            jnp.searchsorted(cumcap, rank, side="right"), Mp - 1
+        )
+    ]
+    lam = jnp.clip(theta - d_eff, 0, INF)
+    lam = jnp.where(dev.s > 0, lam, 0)
+    seated = (rank < k) & dev.task_valid
+    asg0 = jnp.where(
+        dev.task_valid,
+        jnp.where(seated, seat_machine, -1),
+        UNS,
+    ).astype(I32)
+    lvl0 = jnp.where(seated, lam[seat_machine], 0).astype(I32)
+    return asg0, lvl0, lam, theta
+
+
+@partial(
+    jax.jit,
+    static_argnames=("alpha", "max_rounds", "smax", "analytic_init"),
+)
+def _solve(
+    dev: DenseInstance,
+    asg0: jax.Array,
+    lvl0: jax.Array,
+    floor0: jax.Array,
+    eps0: jax.Array,
+    alpha: int,
+    max_rounds: int,
+    smax: int,
+    analytic_init: bool = False,
+):
+    Tp, Mp = dev.c.shape
+    UNS = Mp           # asg code for unscheduled
+    DUMP = Mp + 1      # sort segment for non-participants
+    tids = jnp.arange(Tp, dtype=I32)
+
+    if analytic_init:
+        asg0, lvl0, lam0, _theta = _theta_clearing(dev)
+        floor0 = lam0
+        # the ladder only has to repair the sparse pref perturbations:
+        # eps starts at the largest per-task gain a pref arc offers over
+        # the generic equilibrium option, not at the full cost range
+        v0 = jnp.min(
+            jnp.minimum(dev.c + lam0[None, :], INF), axis=1
+        )
+        gen0 = jnp.minimum(
+            dev.u,
+            jnp.minimum(
+                dev.w
+                + jnp.min(jnp.where(dev.s > 0, dev.dgen + lam0, INF)),
+                INF,
+            ),
+        )
+        gain = jnp.where(dev.task_valid, jnp.maximum(gen0 - v0, 0), 0)
+        eps0 = jnp.maximum(jnp.max(gain), 1).astype(I32)
+
+    def auction_round(asg, lvl, floor, eps):
+        p, _full = _ask_prices(dev, asg, lvl, floor)
+        b1v, m1, v2 = _task_options(dev, p)
+        unassigned = (asg < 0) & dev.task_valid
+        take_uns = unassigned & (dev.u <= b1v)
+        asg = jnp.where(take_uns, UNS, asg)
+        lvl = jnp.where(take_uns, 0, lvl)
+
+        bidder = unassigned & ~take_uns
+        b2 = jnp.minimum(v2, dev.u)
+        c1 = jnp.take_along_axis(dev.c, m1[:, None], axis=1)[:, 0]
+        beta = jnp.minimum(
+            b2.astype(jnp.int64) + eps - c1, jnp.int64(INF - 1)
+        ).astype(I32)
+
+        on_machine = (asg >= 0) & (asg < Mp)
+        key_m = jnp.where(
+            on_machine,
+            asg,
+            jnp.where(asg == UNS, UNS, jnp.where(bidder, m1, DUMP)),
+        )
+        key_lvl = jnp.where(on_machine, lvl, jnp.where(bidder, beta, 0))
+        # holders outrank bidders at equal level: a bid that merely TIES
+        # a holder must not displace it (tid-order displacement at equal
+        # level is a zero-progress carousel — the displaced holder hops
+        # on at the same level forever); with holders-first ties every
+        # successful displacement strictly raises the machine's floor
+        is_bid = jnp.where(on_machine, 0, 1).astype(I32)
+        sm, snl, _sb, st = jax.lax.sort(
+            (key_m, -key_lvl, is_bid, tids), num_keys=4
+        )
+        # rank of each sorted entry within its machine segment
+        first = jax.ops.segment_min(
+            jnp.arange(Tp, dtype=I32), sm, num_segments=Mp + 2
+        )
+        rank = jnp.arange(Tp, dtype=I32) - first[sm]
+        seat = (sm < Mp) & (rank < dev.s[jnp.minimum(sm, Mp - 1)])
+        new_asg = jnp.where(seat, sm, jnp.where(sm == UNS, UNS, -1))
+        new_lvl = jnp.where(seat, -snl, 0)
+        asg = asg.at[st].set(new_asg)
+        lvl = lvl.at[st].set(new_lvl)
+        return asg, lvl
+
+    def violators(asg, lvl, floor, eps):
+        """Standing assignments whose value at the ASK prices is more
+        than eps worse than the task's best option. The ask price (min
+        holder level when full, reserve floor otherwise) is what enters
+        both the primal-dual gap and the eps-CS invariant — a holder's
+        own committed level does not (the primal pays c[t, m], not lvl),
+        so comparing against lvl would release tasks that merely out-bid
+        their seat-mates and cycle forever."""
+        p, _full = _ask_prices(dev, asg, lvl, floor)
+        b1v, _, _ = _task_options(dev, p)
+        b1 = jnp.minimum(b1v, dev.u)
+        on_machine = (asg >= 0) & (asg < Mp)
+        asg_safe = jnp.minimum(jnp.maximum(asg, 0), Mp - 1)
+        cur = jnp.where(
+            on_machine,
+            jnp.minimum(
+                jnp.take_along_axis(
+                    dev.c, asg_safe[:, None], axis=1
+                )[:, 0].astype(jnp.int64)
+                + jnp.where(p[asg_safe] >= INF, 0, p[asg_safe]),
+                jnp.int64(INF),
+            ).astype(I32),
+            jnp.where(asg == UNS, dev.u, INF),
+        )
+        return dev.task_valid & (asg >= 0) & (cur > b1 + eps)
+
+    def deflate(asg, lvl, floor, eps):
+        """Reverse-auction step for FREE machines only.
+
+        Holder levels are never deflated: a full machine's ask is
+        exactly the price the violator check and the certificate use,
+        so an "inflated" full machine (a bidder genuinely paid its
+        premium) is dual-legal and stable — deflating it manufactures
+        envy in every other holder and re-runs the war at the new finer
+        eps (measured: a 1971-unit boundary drop entering eps = 1 cost
+        ~20k serial repair rounds). Free machines are different: their
+        reserve must fall until someone takes the seat or it reaches 0,
+        or the certificate's free => lam = 0 slackness fails. The
+        clearing level is the s_m-th highest willingness-to-pay
+        ``alt_t(-m) - c[t, m]`` over all tasks (alt = the task's best
+        option excluding m, capped by its unsched route); the floor
+        drops to clearing - eps - 1 — strictly below the top bidder's
+        indifference band, so the machine provably either fills or
+        keeps falling (at exactly clearing - eps the STRICT violator
+        test never fires and the reserve would sit stranded forever)."""
+        p, full = _ask_prices(dev, asg, lvl, floor)
+        v = jnp.minimum(dev.c + p[None, :], INF)
+        b1v = jnp.min(v, axis=1)
+        m1 = jnp.argmin(v, axis=1).astype(I32)
+        masked = jnp.where(
+            jnp.arange(Mp, dtype=I32)[None, :] == m1[:, None], INF, v
+        )
+        v2 = jnp.min(masked, axis=1)
+        alt1 = jnp.minimum(b1v, dev.u)
+        alt2 = jnp.minimum(v2, dev.u)
+        alt = jnp.where(
+            jnp.arange(Mp, dtype=I32)[None, :] == m1[:, None],
+            alt2[:, None], alt1[:, None],
+        )
+        will = jnp.clip(alt - dev.c, -INF, INF)
+        will = jnp.where(dev.task_valid[:, None], will, -INF)
+        topw = jax.lax.top_k(will.T, smax)[0]           # [Mp, smax]
+        sidx = jnp.clip(dev.s - 1, 0, smax - 1)
+        clear = jnp.take_along_axis(topw, sidx[:, None], axis=1)[:, 0]
+        floor = jnp.minimum(
+            jnp.where(full, jnp.minimum(floor, p), floor),
+            jnp.clip(clear - eps - 1, 0, INF),
+        )
+        return lvl, floor
+
+    def body(carry):
+        asg, lvl, floor, eps, rounds, phases, done, hist = carry
+        any_unassigned = jnp.any((asg < 0) & dev.task_valid)
+
+        def run_round(_):
+            a, l = auction_round(asg, lvl, floor, eps)
+            h = hist.at[jnp.minimum(phases, 31)].add(1)
+            h = h.at[jnp.minimum(phases, 31) + 96].add(
+                jnp.sum((asg < 0) & dev.task_valid, dtype=I32)
+            )
+            return a, l, floor, eps, rounds + 1, phases, done, h
+
+        def phase_shift(_):
+            # everyone is assigned — but a phase is only COMPLETE when
+            # the state is stable at the CURRENT eps. Tightening eps on
+            # a transient all-assigned state leaves contested-machine
+            # price discovery unresolved and pushes it to the finest
+            # phases, where it crawls at eps per round (measured: an
+            # 11-task pref fight cost 11k rounds at eps=4 this way).
+            viol_now = violators(asg, lvl, floor, eps)
+            any_now = jnp.any(viol_now)
+
+            def refight(_):
+                a = jnp.where(viol_now, -1, asg)
+                l = jnp.where(viol_now, 0, lvl)
+                h = hist.at[jnp.minimum(phases, 31) + 32].add(
+                    jnp.sum(viol_now, dtype=I32)
+                )
+                return (a, l, floor, eps, rounds + 1, phases, done, h)
+
+            def tighten(_):
+                # stable at eps: deflate free-machine reserves, shrink
+                # eps (or finish at eps == 1), release the violators
+                # the tighter tolerance exposes. At the eps = 1
+                # fixpoint any remaining positive reserve on a free
+                # machine is forced to 0 (one extra repair cycle runs
+                # if that creates violators) so the certificate's
+                # complementary slackness is exact.
+                next_eps = jnp.maximum(1, eps // alpha)
+                at_floor = eps <= 1
+                eps_chk = jnp.where(at_floor, eps, next_eps)
+                l0, f0 = deflate(asg, lvl, floor, eps_chk)
+                viol = violators(asg, l0, f0, eps_chk)
+                any_viol = jnp.any(viol)
+                _p, full = _ask_prices(dev, asg, l0, f0)
+                stranded = ~full & (dev.s > 0) & (f0 > 0)
+                force = at_floor & ~any_viol & jnp.any(stranded)
+                f1 = jnp.where(force & stranded, 0, f0)
+                viol2 = jax.lax.cond(
+                    force,
+                    lambda _: violators(asg, l0, f1, eps_chk),
+                    lambda _: viol,
+                    None,
+                )
+                any_viol2 = jnp.any(viol2)
+                a = jnp.where(viol2, -1, asg)
+                l = jnp.where(viol2, 0, l0)
+                new_done = at_floor & ~any_viol2 & ~jnp.any(
+                    ~full & (dev.s > 0) & (f1 > 0)
+                )
+                h = hist.at[jnp.minimum(phases, 31) + 64].add(
+                    jnp.sum(viol2, dtype=I32)
+                )
+                return (a, l, f1, next_eps, rounds + 1, phases + 1,
+                        new_done, h)
+
+            return jax.lax.cond(any_now, refight, tighten, None)
+
+        return jax.lax.cond(any_unassigned, run_round, phase_shift, None)
+
+    if not analytic_init:
+        # a warm state may carry more holders on a machine than its
+        # (possibly shrunk) capacity allows; auction_round's seat trim
+        # only runs while someone is unassigned, and the certificate
+        # does not check capacity — so trim before the loop. The trim
+        # is auction_round's holder ranking with no bidders: sort
+        # holders by (machine, -level, tid), keep the top s_m, release
+        # the rest (they re-bid in the first rounds).
+        on_m0 = (asg0 >= 0) & (asg0 < Mp)
+        km = jnp.where(on_m0, asg0, jnp.where(asg0 == UNS, UNS, DUMP))
+        kl = jnp.where(on_m0, lvl0, 0)
+        sm0, _snl0, st0 = jax.lax.sort((km, -kl, tids), num_keys=3)
+        first0 = jax.ops.segment_min(
+            jnp.arange(Tp, dtype=I32), sm0, num_segments=Mp + 2
+        )
+        rank0 = jnp.arange(Tp, dtype=I32) - first0[sm0]
+        keep = (sm0 >= Mp) | (rank0 < dev.s[jnp.minimum(sm0, Mp - 1)])
+        dropped = jnp.zeros(Tp, bool).at[st0].set(~keep)
+        asg0 = jnp.where(dropped, -1, asg0)
+        lvl0 = jnp.where(dropped, 0, lvl0)
+
+    def cond(carry):
+        rounds, done = carry[4], carry[6]
+        return ~done & (rounds < max_rounds)
+
+    (asg, lvl, floor, eps, rounds, phases, done,
+     hist) = jax.lax.while_loop(
+        cond, body,
+        (asg0, lvl0, floor0, eps0.astype(I32), jnp.int32(0),
+         jnp.int32(0), jnp.bool_(False), jnp.zeros(128, I32)),
+    )
+
+    # exactness certificate: primal - dual at the ask prices, with
+    # lam = 0 on every non-full machine (complementary slackness)
+    lam, full = _ask_prices(dev, asg, lvl, floor)
+    lam = jnp.where(full & (dev.s > 0), lam, 0)
+    b1v, _, _ = _task_options(dev, jnp.where(dev.s > 0, lam, INF))
+    b1 = jnp.minimum(b1v, dev.u)
+    on_machine = (asg >= 0) & (asg < Mp)
+    c_asg = jnp.take_along_axis(
+        dev.c, jnp.minimum(jnp.maximum(asg, 0), Mp - 1)[:, None], axis=1
+    )[:, 0]
+    per_task = jnp.where(
+        on_machine, c_asg, jnp.where(asg == UNS, dev.u, INF)
+    )
+    per_task = jnp.where(dev.task_valid, per_task, 0)
+    primal = jnp.sum(per_task.astype(jnp.int64))
+    dual = jnp.sum(
+        jnp.where(dev.task_valid, b1, 0).astype(jnp.int64)
+    ) - jnp.sum(dev.s.astype(jnp.int64) * lam.astype(jnp.int64))
+    gap = primal - dual
+    converged = done & (gap >= 0) & (gap < dev.scale.astype(jnp.int64))
+    return asg, lvl, floor, gap, converged, rounds, phases, hist
+
+
+def solve_dense(
+    inst_dev: DenseInstance,
+    *,
+    warm: DenseState | None = None,
+    alpha: int = 4,
+    max_rounds: int = 20_000,
+) -> DenseState:
+    """Run the auction on device; returns device-resident state.
+
+    ``warm`` (a previous solve's state over the same padded shapes, e.g.
+    after a small cost/slot delta) skips the eps ladder and re-settles at
+    eps = 1 — the incremental re-solve path mirroring the reference's
+    ``--run_incremental_scheduler`` seam (deploy/poseidon.cfg:12).
+    No host synchronization happens here; read the result fields (one
+    device_get) only when needed.
+    """
+    Tp, Mp = inst_dev.c.shape
+    smax = inst_dev.smax
+    if warm is not None and (
+        warm.asg.shape[0] != Tp or warm.floor.shape[0] != Mp
+    ):
+        warm = None  # cluster outgrew its padding bucket: cold solve
+    analytic = warm is None
+    if analytic:
+        # placeholders; the kernel's analytic clearing start replaces
+        # them (keeping one compiled program for the cold path)
+        asg0 = jnp.where(inst_dev.task_valid, -1, Mp).astype(I32)
+        lvl0 = jnp.zeros(Tp, I32)
+        floor0 = jnp.zeros(Mp, I32)
+        eps0 = jnp.maximum(inst_dev.cmax // alpha, 1)
+    else:
+        asg0 = warm.asg
+        lvl0 = warm.lvl
+        floor0 = warm.floor
+        eps0 = jnp.int32(1)
+    with jax.enable_x64(True):
+        asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
+            inst_dev, asg0, lvl0, floor0, eps0, alpha=alpha,
+            max_rounds=max_rounds, smax=smax, analytic_init=analytic,
+        )
+    return DenseState(
+        asg=asg, lvl=lvl, floor=floor, gap=gap, converged=converged,
+        rounds=rounds, phases=phases,
+    )
+
+
+# ---------------------------------------------------------------------------
+# host wrapper
+# ---------------------------------------------------------------------------
+
+def _channels_for(inst: TransportInstance, asg: np.ndarray) -> np.ndarray:
+    """Cheapest channel code per task for a machine assignment."""
+    T = inst.n_tasks
+    ch = np.full(T, CH_UNSCHED, np.int32)
+    on = asg >= 0
+    if not on.any():
+        return ch
+    m = np.maximum(asg, 0)
+    w = np.asarray(inst.w, np.int64)
+    d = np.asarray(inst.d, np.int64)
+    ra = np.asarray(inst.ra, np.int64)
+    best = np.where(on, np.minimum(w + d[m], _NPINF), _NPINF)
+    ch = np.where(on, CH_CLUSTER, CH_UNSCHED).astype(np.int32)
+    for k in range(inst.max_prefs):
+        pc = np.asarray(inst.pref_cost[:, k], np.int64)
+        hit_m = on & (inst.pref_machine[:, k] == asg)
+        val = np.where(hit_m, pc, _NPINF)
+        hit_r = on & (inst.pref_rack[:, k] >= 0) & (
+            inst.pref_rack[:, k] == inst.rack_of[m]
+        )
+        val = np.minimum(val, np.where(hit_r, pc + ra[m], _NPINF))
+        better = val < best
+        best = np.where(better, val, best)
+        ch = np.where(better, CH_PREF + k, ch).astype(np.int32)
+    return ch
+
+
+def _objective(inst: TransportInstance, ch: np.ndarray,
+               asg: np.ndarray) -> int:
+    T = inst.n_tasks
+    if T == 0:
+        return 0
+    m = np.maximum(np.asarray(asg), 0)
+    k = np.maximum(np.asarray(ch) - CH_PREF, 0)
+    pref_c = np.take_along_axis(
+        np.asarray(inst.pref_cost, np.int64), k[:, None], axis=1
+    )[:, 0]
+    is_rack = np.take_along_axis(
+        inst.pref_rack, k[:, None], axis=1
+    )[:, 0] >= 0
+    per_task = np.where(
+        (ch == CH_UNSCHED) | (asg < 0),
+        np.asarray(inst.u, np.int64),
+        np.where(
+            ch == CH_CLUSTER,
+            np.asarray(inst.w, np.int64) + np.asarray(inst.d, np.int64)[m],
+            pref_c + np.where(is_rack, np.asarray(inst.ra, np.int64)[m], 0),
+        ),
+    )
+    return int(per_task.sum())
+
+
+def solve_transport_dense(
+    inst: TransportInstance,
+    *,
+    warm: DenseState | None = None,
+    alpha: int = 4,
+    max_rounds: int = 20_000,
+) -> tuple[TransportResult, DenseState]:
+    """Host-facing wrapper: densify, solve on device, read back once."""
+    T = inst.n_tasks
+    if T == 0:
+        return (
+            TransportResult(
+                assignment=np.zeros(0, np.int32),
+                channel=np.zeros(0, np.int32),
+                cost=0, rounds=0, phases=0, converged=True,
+            ),
+            None,
+        )
+    dev = build_dense_instance(inst)
+    state = solve_dense(dev, warm=warm, alpha=alpha, max_rounds=max_rounds)
+    asg_np, conv, rounds, phases = jax.device_get(
+        (state.asg, state.converged, state.rounds, state.phases)
+    )
+    Mp = dev.c.shape[1]
+    asg = np.asarray(asg_np[:T], np.int32)
+    asg = np.where((asg >= 0) & (asg < Mp) & (asg < inst.n_machines),
+                   asg, -1).astype(np.int32)
+    ch = _channels_for(inst, asg)
+    return (
+        TransportResult(
+            assignment=asg,
+            channel=ch,
+            cost=_objective(inst, ch, asg),
+            rounds=int(rounds),
+            phases=int(phases),
+            converged=bool(conv),
+        ),
+        state,
+    )

@@ -30,7 +30,7 @@ because prices only rise).
 
 This file holds the instance extraction and the numpy reference
 implementation (the CPU correctness baseline for differential tests);
-the device kernel is the vectorized JAX auction in ops/transport_tpu.py,
+the device kernel is the dense class-price auction in ops/dense_auction.py,
 reached through the ``poseidon_tpu.solve_scheduling`` front door.
 """
 
@@ -113,6 +113,13 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     builder's shape contract (in which case callers fall back to the
     general solvers).
     """
+    if int(net.n_arcs) != int(meta.n_arcs) or int(net.n_nodes) != int(
+        meta.n_nodes
+    ):
+        raise NotSchedulingShaped(
+            f"network ({net.n_nodes} nodes / {net.n_arcs} arcs) does not "
+            f"match the builder metadata ({meta.n_nodes} / {meta.n_arcs})"
+        )
     host = net.to_host()
     cost = host["cost"].astype(np.int64)
     cap = host["cap"].astype(np.int64)

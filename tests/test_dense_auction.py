@@ -1,0 +1,207 @@
+"""Differential tests: dense-auction TPU solver vs the C++ CPU oracle.
+
+The solver certifies its own exactness at runtime (primal-dual gap) —
+these tests check the certificate against ground truth: every converged
+solve must match the oracle's optimal cost bit-for-bit, over random
+clusters spanning all cost models, plus the degenerate shapes that broke
+earlier designs (all-tied markets, over-subscribed capacity, empty
+clusters).
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.graph.builder import FlowGraphBuilder
+from poseidon_tpu.graph.decompose import extract_placements
+from poseidon_tpu.ops.dense_auction import (
+    CostDomainTooLarge,
+    build_dense_instance,
+    solve_transport_dense,
+)
+from poseidon_tpu.ops.transport import extract_instance, flows_from_assignment
+from poseidon_tpu.oracle import solve_oracle
+from poseidon_tpu.solver import solve_scheduling
+
+from tests.helpers import random_cluster, price
+
+MODELS = ["trivial", "quincy", "octopus", "wharemap", "coco", "random"]
+
+
+def _build(rng, n_machines, n_tasks, model):
+    cluster = random_cluster(rng, n_machines, n_tasks)
+    net, meta = FlowGraphBuilder().build(cluster)
+    net = price(net, meta, model, cluster)
+    return net, meta, extract_instance(net, meta)
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_cold_matches_oracle(self, seed):
+        """Converged solves must equal the oracle bit-for-bit, and the
+        front door must be exact even when the auction's certificate
+        refuses (fallback). The ladder cost models (trivial/quincy/coco
+        at BASELINE-like subscription) must certify on the dense path;
+        adversarial tie-heavy shapes under the random/octopus models
+        are allowed to fall back — but never to be silently wrong."""
+        rng = np.random.default_rng(seed)
+        stats = {"converged": 0, "total": 0}
+        for trial in range(6):
+            model = MODELS[(seed + trial) % len(MODELS)]
+            M = int(rng.integers(2, 40))
+            T = int(rng.integers(2, 150))
+            net, meta, inst = _build(rng, M, T, model)
+            res, state = solve_transport_dense(inst)
+            o = solve_oracle(net, algorithm="cost_scaling")
+            stats["total"] += 1
+            if res.converged:
+                stats["converged"] += 1
+                assert res.cost == o.cost, (model, M, T)
+            else:
+                out = solve_scheduling(net, meta)
+                assert out.exact and out.cost == o.cost, (model, M, T)
+            if model in ("trivial", "quincy"):
+                assert res.converged, (model, M, T, res.rounds)
+        assert stats["converged"] >= stats["total"] * 2 // 3, stats
+
+    def test_warm_resolve_matches(self):
+        rng = np.random.default_rng(7)
+        net, meta, inst = _build(rng, 20, 80, "quincy")
+        res, state = solve_transport_dense(inst)
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert res.converged and res.cost == o.cost
+        res2, _ = solve_transport_dense(inst, warm=state)
+        assert res2.converged and res2.cost == o.cost
+        # warm settles immediately: no eps ladder
+        assert res2.phases <= 2
+
+    def test_flows_are_feasible_routing(self):
+        rng = np.random.default_rng(11)
+        net, meta, inst = _build(rng, 12, 60, "quincy")
+        res, _ = solve_transport_dense(inst)
+        assert res.converged
+        flows = flows_from_assignment(inst, res, int(net.n_arcs))
+        placements = extract_placements(
+            flows, meta, np.asarray(net.src), np.asarray(net.dst)
+        )
+        placed = sum(1 for v in placements.values() if v)
+        assert placed == int((res.assignment >= 0).sum())
+
+
+class TestDegenerate:
+    def test_all_tied_market(self):
+        """Uniform u/w/prefs — the tie carousel that livelocked earlier
+        designs (zero-progress displacement on task-id order)."""
+        from poseidon_tpu.cluster import ClusterState, Machine, Task
+
+        machines = [
+            Machine(
+                name=f"m{i}", rack="r0",
+                cpu_capacity=8, cpu_allocatable=8,
+                memory_capacity_kb=1 << 20,
+                memory_allocatable_kb=1 << 20, max_tasks=1,
+            )
+            for i in range(10)
+        ]
+        tasks = [
+            Task(
+                uid=f"t{j}", job="j0", cpu_request=1.0,
+                memory_request_kb=1 << 10,
+                data_prefs={f"m{j % 10}": 5},
+            )
+            for j in range(14)
+        ]
+        cluster = ClusterState(machines=machines, tasks=tasks)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "trivial", cluster)
+        inst = extract_instance(net, meta)
+        res, _ = solve_transport_dense(inst)
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert res.converged and res.cost == o.cost
+
+    def test_oversubscribed_capacity(self):
+        rng = np.random.default_rng(3)
+        net, meta, inst = _build(rng, 3, 120, "quincy")
+        res, _ = solve_transport_dense(inst)
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert res.converged and res.cost == o.cost
+
+    def test_empty_tasks(self):
+        rng = np.random.default_rng(4)
+        cluster = random_cluster(rng, 5, 3)
+        cluster.tasks.clear()
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "trivial", cluster)
+        inst = extract_instance(net, meta)
+        res, state = solve_transport_dense(inst)
+        assert res.converged and res.cost == 0
+
+    def test_warm_capacity_shrink_revalidates(self):
+        """A warm state carrying more holders than a machine's shrunk
+        capacity must not certify an infeasible assignment."""
+        from poseidon_tpu.ops.dense_auction import build_dense_instance, solve_dense
+        import dataclasses as dc
+        import jax
+
+        rng = np.random.default_rng(9)
+        cluster = random_cluster(rng, 6, 30)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "trivial", cluster)
+        inst = extract_instance(net, meta)
+        res, state = solve_transport_dense(inst)
+        assert res.converged
+        shrunk = dc.replace(
+            inst, slots=np.maximum(inst.slots - 2, 0).astype(np.int32)
+        )
+        dev2 = build_dense_instance(shrunk)
+        st2 = solve_dense(dev2, warm=state)
+        asg2, conv2 = jax.device_get((st2.asg, st2.converged))
+        counts = np.bincount(
+            asg2[(asg2 >= 0) & (asg2 < dev2.c.shape[1])],
+            minlength=dev2.c.shape[1],
+        )
+        assert (counts[: shrunk.n_machines]
+                <= np.asarray(shrunk.slots)).all()
+
+    def test_cost_domain_guard(self):
+        rng = np.random.default_rng(5)
+        cluster = random_cluster(rng, 4, 30)
+        net, meta = FlowGraphBuilder().build(cluster)
+        big = np.asarray(net.cost).copy()
+        big[: meta.n_arcs] = 2**30
+        net = net.with_costs(__import__("jax.numpy", fromlist=["x"]).asarray(big))
+        inst = extract_instance(net, meta)
+        with pytest.raises(CostDomainTooLarge):
+            build_dense_instance(inst)
+
+
+class TestFrontDoor:
+    def test_solve_scheduling_dense_path(self):
+        rng = np.random.default_rng(21)
+        cluster = random_cluster(rng, 15, 70)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "quincy", cluster)
+        out = solve_scheduling(net, meta)
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert out.backend == "dense_auction"
+        assert out.exact and out.cost == o.cost
+        # warm round over the same shapes reuses device state
+        out2 = solve_scheduling(net, meta, warm=out.state)
+        assert out2.cost == o.cost
+
+    def test_solve_scheduling_oracle_fallback_on_shape(self):
+        from poseidon_tpu.graph.dimacs import read_dimacs
+
+        net = read_dimacs(
+            "p min 4 3\nn 1 2\nn 4 -2\n"
+            "a 1 2 0 2 3\na 2 3 0 2 1\na 3 4 0 2 2\n"
+        )
+        # a bare DIMACS net has no GraphMeta: fake a minimal one via the
+        # builder on an empty cluster, then hand the DIMACS net over
+        from poseidon_tpu.cluster import ClusterState
+
+        _, meta = FlowGraphBuilder().build(
+            ClusterState(machines=[], tasks=[])
+        )
+        out = solve_scheduling(net, meta)
+        assert out.backend.startswith("oracle:")
+        assert out.cost == 12

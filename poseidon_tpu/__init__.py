@@ -3,18 +3,23 @@
 Re-implements the capability surface of Poseidon (Firmament's Kubernetes
 integration; reference: /root/reference, see SURVEY.md) as a from-scratch
 JAX/XLA framework: the cluster is modeled as a min-cost max-flow problem
-whose arc/node tables live as padded device arrays and whose solve runs as
-a jit-compiled cost-scaling kernel on TPU, instead of the reference's
+whose tables live as padded device arrays and whose solve runs as one
+jit-compiled dense-auction kernel on TPU, instead of the reference's
 fork/exec of a CPU solver binary (reference deploy/poseidon.cfg:8-10).
 
 Layers (SURVEY.md section 7):
   graph/     L0  — structure-of-arrays flow network, builder, DIMACS I/O
   oracle/    L2a'— C++ CPU MCMF oracle (correctness + baseline)
-  ops/       L1  — JAX solver kernels (SSP, cost-scaling push-relabel)
-  models/    L3' — vectorized cost models (Trivial, Quincy, CoCo, Whare-Map)
-  bridge/    L4' — scheduler bridge + pod state machine
-  apiclient/ L2b'— Kubernetes API client + fake apiserver fixture
-  parallel/       — device mesh / shard_map solver partitioning
+  ops/       L1  — JAX solver kernels (dense auction, SSP, cost-scaling,
+                   vmap what-if batching)
+  models/    L3' — vectorized cost models (Trivial, Quincy, CoCo,
+                   Whare-Map, Octopus) + KnowledgeBase sample rings
+  parallel/      — device-mesh sharding (NamedSharding / shard_map+psum)
+  solver.py      — the front door: solve_scheduling() with warm handles
 """
 
-__version__ = "0.1.0"
+from poseidon_tpu.solver import SolveOutcome, solve_scheduling
+
+__version__ = "0.3.0"
+
+__all__ = ["SolveOutcome", "solve_scheduling", "__version__"]

@@ -1,0 +1,164 @@
+"""Batched what-if scheduling: vmap over perturbed instances.
+
+BASELINE config 5: solve 64 cost-model variants of the same cluster in
+ONE compiled program — "what would placement look like if these costs
+shifted" — a capability the reference's architecture cannot express at
+all (its solver seam is one fork/exec of a CPU binary per instance,
+deploy/poseidon.cfg:8-10). Here the dense-auction kernel is ``vmap``-ed
+over the leading batch axis of the cost tables; every variant runs the
+full eps ladder in lockstep on device, so amortized per-instance time
+is a fraction of a single solve.
+
+Only cost-side arrays (c, u, w, dgen) vary per variant; topology
+(slots, task_valid) is shared. Perturbations are deterministic per
+(seed, variant).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poseidon_tpu.ops.dense_auction import (
+    I32,
+    INF,
+    DenseInstance,
+    _solve,
+    build_dense_instance,
+)
+from poseidon_tpu.ops.transport import TransportInstance
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchResult:
+    """One entry per variant; arrays are host numpy."""
+
+    costs: np.ndarray        # int64[B] exact objective per variant
+    converged: np.ndarray    # bool[B]
+    assignments: np.ndarray  # int32[B, T] machine index, or -1 (unsched)
+    rounds: np.ndarray       # int32[B]
+
+
+@partial(jax.jit, static_argnames=("smax", "alpha", "max_rounds"))
+def _solve_batch(c, u, w, dgen, cmax, s, task_valid, scale,
+                 smax, alpha, max_rounds):
+    Tp, Mp = c.shape[1], c.shape[2]
+
+    def one(c1, u1, w1, dg1, cm1):
+        dev = DenseInstance(
+            c=c1, u=u1, w=w1, dgen=dg1, s=s, task_valid=task_valid,
+            scale=scale, cmax=cm1, smax=smax,
+        )
+        asg0 = jnp.where(task_valid, -1, Mp).astype(I32)
+        lvl0 = jnp.zeros(Tp, I32)
+        floor0 = jnp.zeros(Mp, I32)
+        eps0 = jnp.maximum(cm1 // alpha, 1)
+        asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
+            dev, asg0, lvl0, floor0, eps0, alpha=alpha,
+            max_rounds=max_rounds, smax=smax, analytic_init=True,
+        )
+        # exact per-variant objective from the assignment
+        on_m = (asg >= 0) & (asg < Mp)
+        c_asg = jnp.take_along_axis(
+            c1, jnp.clip(asg, 0, Mp - 1)[:, None], axis=1
+        )[:, 0]
+        per_task = jnp.where(on_m, c_asg, jnp.where(asg == Mp, u1, 0))
+        cost = jnp.sum(
+            jnp.where(task_valid, per_task, 0).astype(jnp.int64)
+        )
+        return cost, converged, asg, rounds
+
+    return jax.vmap(one)(c, u, w, dgen, cmax)
+
+
+def perturb_costs(
+    inst_dev: DenseInstance, n_variants: int, seed: int,
+    magnitude_pct: int = 10,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Deterministic multiplicative jitter on the finite cost entries.
+
+    Variant 0 is the unperturbed instance. Each other variant scales
+    every finite cost by an independent factor in
+    [1 - magnitude_pct%, 1 + magnitude_pct%].
+    """
+    key = jax.random.PRNGKey(seed)
+
+    scale = jnp.int64(inst_dev.scale)
+
+    def jitter(k, x):
+        # jitter the UNSCALED cost, then rescale: perturbed entries
+        # stay exact multiples of scale, so the eps = 1 phase still
+        # pins the exact optimum of each perturbed instance
+        f = jax.random.randint(
+            k, x.shape, 100 - magnitude_pct, 101 + magnitude_pct
+        ).astype(jnp.int64)
+        unscaled = x.astype(jnp.int64) // scale
+        y = jnp.where(
+            x < INF,
+            jnp.clip((unscaled * f // 100) * scale, 0, INF - 1),
+            INF,
+        )
+        return y.astype(I32)
+
+    cs, us, ws, ds = [], [], [], []
+    for b in range(n_variants):
+        if b == 0:
+            cs.append(inst_dev.c)
+            us.append(inst_dev.u)
+            ws.append(inst_dev.w)
+            ds.append(inst_dev.dgen)
+        else:
+            kb = jax.random.fold_in(key, b)
+            k1, k2, k3, k4 = jax.random.split(kb, 4)
+            cs.append(jitter(k1, inst_dev.c))
+            us.append(jitter(k2, inst_dev.u))
+            ws.append(jitter(k3, inst_dev.w))
+            ds.append(jitter(k4, inst_dev.dgen))
+    c = jnp.stack(cs)
+    u = jnp.stack(us)
+    w = jnp.stack(ws)
+    dg = jnp.stack(ds)
+    cmax = jnp.maximum(
+        jnp.max(jnp.where(c < INF, c, 0), axis=(1, 2)) * 2,
+        1,
+    ).astype(I32)
+    return c, u, w, dg, cmax
+
+
+def solve_what_if(
+    inst: TransportInstance,
+    *,
+    n_variants: int = 64,
+    seed: int = 0,
+    magnitude_pct: int = 10,
+    alpha: int = 4,
+    max_rounds: int = 20_000,
+) -> BatchResult:
+    """Solve ``n_variants`` perturbed copies of ``inst`` in one program."""
+    dev = build_dense_instance(inst)
+    c, u, w, dg, cmax = perturb_costs(
+        dev, n_variants, seed, magnitude_pct=magnitude_pct
+    )
+    with jax.enable_x64(True):
+        cost, conv, asg, rounds = _solve_batch(
+            c, u, w, dg, cmax, dev.s, dev.task_valid, dev.scale,
+            smax=dev.smax, alpha=alpha, max_rounds=max_rounds,
+        )
+    T = inst.n_tasks
+    Mp = dev.c.shape[1]
+    asg_np = np.asarray(asg, np.int32)[:, :T]
+    asg_np = np.where(
+        (asg_np >= 0) & (asg_np < inst.n_machines), asg_np, -1
+    ).astype(np.int32)
+    # kernel costs are in the scaled domain (x scale); every per-task
+    # term is a multiple of scale, so this division is exact
+    return BatchResult(
+        costs=np.asarray(cost, np.int64) // (T + 1),
+        converged=np.asarray(conv, bool),
+        assignments=asg_np,
+        rounds=np.asarray(rounds, np.int32),
+    )

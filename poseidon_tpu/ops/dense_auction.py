@@ -208,7 +208,7 @@ def build_dense_instance(inst: TransportInstance) -> DenseInstance:
         task_valid=jnp.asarray(task_valid),
         scale=jnp.int32(scale),
         cmax=jnp.int32(min(cmax_scaled, int(INF) - 1)),
-        smax=max(int(np.max(slots, initial=0)), 1),
+        smax=max(min(int(np.max(slots, initial=0)), Tp), 1),
     )
 
 
@@ -242,7 +242,7 @@ def _ask_prices(dev: DenseInstance, asg, lvl, floor):
     return jnp.where(dev.s > 0, p, INF), full
 
 
-def _task_options(dev: DenseInstance, p):
+def _task_options(dev: DenseInstance, p, with_values: bool = False):
     """Per-task best/second-best machine values at prices p."""
     v = jnp.minimum(dev.c + p[None, :], INF)
     b1v = jnp.min(v, axis=1)
@@ -251,6 +251,8 @@ def _task_options(dev: DenseInstance, p):
         jnp.arange(v.shape[1], dtype=I32)[None, :] == m1[:, None], INF, v
     )
     v2 = jnp.min(masked, axis=1)
+    if with_values:
+        return b1v, m1, v2, v
     return b1v, m1, v2
 
 
@@ -455,13 +457,7 @@ def _solve(
         keeps falling (at exactly clearing - eps the STRICT violator
         test never fires and the reserve would sit stranded forever)."""
         p, full = _ask_prices(dev, asg, lvl, floor)
-        v = jnp.minimum(dev.c + p[None, :], INF)
-        b1v = jnp.min(v, axis=1)
-        m1 = jnp.argmin(v, axis=1).astype(I32)
-        masked = jnp.where(
-            jnp.arange(Mp, dtype=I32)[None, :] == m1[:, None], INF, v
-        )
-        v2 = jnp.min(masked, axis=1)
+        b1v, m1, v2, v = _task_options(dev, p, with_values=True)
         alt1 = jnp.minimum(b1v, dev.u)
         alt2 = jnp.minimum(v2, dev.u)
         alt = jnp.where(

@@ -1,0 +1,24 @@
+"""Device-mesh partitioning for the scheduling solver.
+
+The reference's only compute-parallel seam is fork/exec of a solver
+binary per round (deploy/poseidon.cfg:8-9); its cross-machine story is
+HTTP to the apiserver. The TPU-native replacement spans chips instead:
+the dense auction's task-axis tables shard over a ``jax.sharding.Mesh``
+(GSPMD inserts the collectives the sorts/segment-reductions need over
+ICI), and the exactness certificate has an explicit ``shard_map`` +
+``psum`` implementation whose partial sums ride the same mesh.
+"""
+
+from poseidon_tpu.parallel.mesh import make_mesh
+from poseidon_tpu.parallel.sharded import (
+    shard_instance,
+    sharded_certificate_gap,
+    solve_dense_sharded,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_instance",
+    "sharded_certificate_gap",
+    "solve_dense_sharded",
+]

@@ -1,0 +1,25 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "tasks") -> Mesh:
+    """A 1-D device mesh over the first ``n_devices`` local devices.
+
+    One axis is all the solver needs: the dense auction's state is
+    task-major, and machine-side tables are small enough to replicate
+    (M * S ints), so the natural layout is task-sharded / machine-
+    replicated — collectives then only carry per-machine aggregates
+    (price tables, seat thresholds), never the [T, M] cost table.
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}"
+            )
+        devs = devs[:n_devices]
+    return Mesh(devs, (axis,))

@@ -88,6 +88,12 @@ def solve_scheduling(
         if not oracle_fallback:
             raise
         return _solve_on_oracle(net, t0, why="cost-domain")
+    except ValueError:
+        # defensive: an instance outside the kernel's envelope (e.g.
+        # negative costs from a custom model) must degrade, not crash
+        if not oracle_fallback:
+            raise
+        return _solve_on_oracle(net, t0, why="kernel-envelope")
     if not res.converged and warm is not None:
         # a stale warm start can strand the eps=1 settle; retry cold
         res, state = solve_transport_dense(inst, warm=None)

@@ -162,6 +162,33 @@ class TestDegenerate:
         assert (counts[: shrunk.n_machines]
                 <= np.asarray(shrunk.slots)).all()
 
+    def test_more_slots_than_tasks(self):
+        """A machine with more slots than the padded task count (k8s
+        default 110 pods/node, few pending pods) must solve, not crash
+        deflate's top_k."""
+        from poseidon_tpu.cluster import ClusterState, Machine, Task
+
+        machines = [
+            Machine(
+                name="big", rack="r0", cpu_capacity=64,
+                cpu_allocatable=64, memory_capacity_kb=1 << 24,
+                memory_allocatable_kb=1 << 24, max_tasks=110,
+            )
+        ]
+        tasks = [
+            Task(uid=f"t{j}", job="j0", cpu_request=0.5,
+                 memory_request_kb=1 << 10)
+            for j in range(4)
+        ]
+        net, meta = FlowGraphBuilder().build(
+            ClusterState(machines=machines, tasks=tasks)
+        )
+        net = price(net, meta, "trivial", None)
+        inst = extract_instance(net, meta)
+        res, _ = solve_transport_dense(inst)
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert res.converged and res.cost == o.cost
+
     def test_cost_domain_guard(self):
         rng = np.random.default_rng(5)
         cluster = random_cluster(rng, 4, 30)

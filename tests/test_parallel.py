@@ -1,0 +1,103 @@
+"""Multi-device tests: sharded solve + shard_map certificate + what-if.
+
+Run on the 8-virtual-CPU-device platform the conftest forces — the
+"multi-node without a real cluster" answer for the TPU solver (SURVEY
+§4): the same shardings compile to ICI collectives on a real slice.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from poseidon_tpu.graph.builder import FlowGraphBuilder
+from poseidon_tpu.ops.batch import solve_what_if
+from poseidon_tpu.ops.dense_auction import (
+    build_dense_instance,
+    solve_dense,
+    solve_transport_dense,
+)
+from poseidon_tpu.ops.transport import extract_instance
+from poseidon_tpu.oracle import solve_oracle
+from poseidon_tpu.parallel import (
+    make_mesh,
+    shard_instance,
+    sharded_certificate_gap,
+    solve_dense_sharded,
+)
+
+from tests.helpers import random_cluster, price
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 devices"
+    return make_mesh(8)
+
+
+def _instance(seed, n_machines=12, n_tasks=128, model="quincy"):
+    rng = np.random.default_rng(seed)
+    cluster = random_cluster(rng, n_machines, n_tasks)
+    net, meta = FlowGraphBuilder().build(cluster)
+    net = price(net, meta, model, cluster)
+    return net, extract_instance(net, meta)
+
+
+class TestShardedSolve:
+    def test_bit_identical_vs_single_device(self, mesh8):
+        net, inst = _instance(0)
+        dev = build_dense_instance(inst)
+        single = solve_dense(dev)
+        sharded = solve_dense_sharded(dev, mesh8)
+        s_asg, s_conv = jax.device_get((sharded.asg, sharded.converged))
+        r_asg, r_conv = jax.device_get((single.asg, single.converged))
+        assert bool(s_conv) and bool(r_conv)
+        assert (np.asarray(s_asg) == np.asarray(r_asg)).all()
+
+    def test_sharded_exact_vs_oracle(self, mesh8):
+        net, inst = _instance(1, model="trivial")
+        dev = build_dense_instance(inst)
+        state = solve_dense_sharded(dev, mesh8)
+        res, _ = solve_transport_dense(inst)  # host decode path
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert bool(jax.device_get(state.converged))
+        assert res.cost == o.cost
+
+    def test_shard_map_certificate_matches_kernel(self, mesh8):
+        net, inst = _instance(2)
+        dev = build_dense_instance(inst)
+        sdev = shard_instance(dev, mesh8)
+        state = solve_dense_sharded(dev, mesh8)
+        gap_kernel = int(jax.device_get(state.gap))
+        gap_psum = sharded_certificate_gap(sdev, state, mesh8)
+        assert gap_psum == gap_kernel
+
+    def test_sharded_warm_resolve(self, mesh8):
+        net, inst = _instance(3)
+        dev = build_dense_instance(inst)
+        state = solve_dense_sharded(dev, mesh8)
+        warm = solve_dense_sharded(dev, mesh8, warm=state)
+        assert bool(jax.device_get(warm.converged))
+        a1, a2 = jax.device_get((state.asg, warm.asg))
+        # same optimum value; assignment may permute among ties, so
+        # compare objective via the host decode
+        r1, _ = solve_transport_dense(inst)
+        r2, _ = solve_transport_dense(inst, warm=state)
+        assert r1.cost == r2.cost
+
+
+class TestWhatIfBatch:
+    def test_variant_zero_is_unperturbed(self):
+        net, inst = _instance(4, n_tasks=64)
+        batch = solve_what_if(inst, n_variants=4, seed=7)
+        res, _ = solve_transport_dense(inst)
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert batch.converged[0]
+        # variant 0 is unperturbed: must equal the exact optimum
+        assert int(batch.costs[0]) == o.cost == res.cost
+
+    def test_batch_shapes_and_convergence(self):
+        net, inst = _instance(5, n_tasks=64)
+        batch = solve_what_if(inst, n_variants=8, seed=3)
+        assert batch.costs.shape == (8,)
+        assert batch.assignments.shape == (8, inst.n_tasks)
+        assert batch.converged.all(), batch.rounds

@@ -16,6 +16,10 @@ Layers (SURVEY.md section 7):
                    Whare-Map, Octopus) + KnowledgeBase sample rings
   parallel/      — device-mesh sharding (NamedSharding / shard_map+psum)
   solver.py      — the front door: solve_scheduling() with warm handles
+  bridge/    L4' — scheduler bridge: pod/node state machine, stats,
+                   decision log, restart reconcile
+  apiclient/ L2b'— Kubernetes API client + fake apiserver fixture
+  cli.py     L5' — the scheduling daemon (poll loop, reference flags)
 """
 
 from poseidon_tpu.solver import SolveOutcome, solve_scheduling

@@ -1,0 +1,6 @@
+"""L2b' — Kubernetes API client + fake apiserver test fixture."""
+
+from poseidon_tpu.apiclient.client import K8sApiClient, parse_cpu, parse_memory_kb
+from poseidon_tpu.apiclient.fake_server import FakeApiServer
+
+__all__ = ["K8sApiClient", "FakeApiServer", "parse_cpu", "parse_memory_kb"]

@@ -1,0 +1,245 @@
+"""Kubernetes core-v1 REST client (the reference's five-operation surface).
+
+Mirrors src/apiclient/k8s_api_client.{h,cc}: GET ``nodes`` / ``pods``
+(optionally label-filtered) parsed into the framework's ``Machine`` /
+``Task`` DTOs, and the bindings POST that makes placements real
+(k8s_api_client.cc:67-94, JSON shape at :75-79). Differences on purpose:
+
+- unit parsing is correct for the full k8s quantity grammar (m-suffixed
+  CPU, Ki/Mi/Gi/K/M/G memory) instead of the reference's "strip the last
+  two characters and hope it was Ki" (k8s_api_client.cc:260-265) — a
+  noted fidelity gap (SURVEY §3.4);
+- the namespace comes from the pod instead of being hardcoded
+  ``default`` (k8s_api_client.cc:222);
+- transport errors raise ``ApiError`` after bounded retries instead of
+  dissolving into logged JSON (utils.cc:47-61); the driver loop decides
+  to skip the tick.
+
+Transport is stdlib urllib on purpose: the control plane is a few small
+JSON GETs per 10-second tick (deploy/poseidon.cfg / --polling_frequency),
+three orders of magnitude off the solve path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from poseidon_tpu.cluster import Machine, Task, TaskPhase
+
+log = logging.getLogger(__name__)
+
+RACK_LABELS = (
+    "topology.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/zone",
+    "rack",
+)
+
+
+class ApiError(RuntimeError):
+    """The apiserver could not be reached or answered garbage."""
+
+
+def parse_cpu(q: str | int | float) -> float:
+    """k8s CPU quantity -> cores ("100m" -> 0.1, "2" -> 2.0)."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    q = q.strip()
+    if not q:
+        return 0.0
+    if q.endswith("m"):
+        return float(q[:-1]) / 1000.0
+    return float(q)
+
+
+_MEM_FACTORS = {"Ki": 1, "Mi": 1 << 10, "Gi": 1 << 20, "Ti": 1 << 30}
+
+
+def parse_memory_kb(q: str | int) -> int:
+    """k8s memory quantity -> KiB ("128Mi" -> 131072, "1Gi" -> 1048576,
+    plain integers are bytes)."""
+    if isinstance(q, int):
+        return q >> 10
+    q = q.strip()
+    if not q:
+        return 0
+    for suffix in ("Ki", "Mi", "Gi", "Ti"):
+        if q.endswith(suffix):
+            return int(float(q[: -len(suffix)]) * _MEM_FACTORS[suffix])
+    for suffix, f in (("T", 976562500), ("G", 976563), ("M", 977),
+                      ("k", 1), ("K", 1)):
+        if q.endswith(suffix):
+            return int(float(q[:-1]) * f)
+    return int(q) >> 10  # bare bytes
+
+
+class K8sApiClient:
+    """Five operations against one base URI (k8s_api_client.h:44-48)."""
+
+    def __init__(
+        self,
+        host: str = "localhost",
+        port: int = 8080,
+        api_version: str = "v1",
+        *,
+        timeout_s: float = 10.0,
+        retries: int = 2,
+    ):
+        self.base = f"http://{host}:{port}/api/{api_version}"
+        self.timeout_s = timeout_s
+        self.retries = retries
+        log.info("k8s api client -> %s", self.base)
+
+    # ---- transport -----------------------------------------------------
+
+    def _request(self, path: str, body: dict | None = None) -> dict:
+        url = f"{self.base}/{path}"
+        data = None
+        headers = {}
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        last: Exception | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                req = urllib.request.Request(
+                    url, data=data, headers=headers
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout_s
+                ) as resp:
+                    payload = resp.read()
+                return json.loads(payload) if payload else {}
+            except (urllib.error.URLError, json.JSONDecodeError,
+                    TimeoutError) as e:
+                last = e
+                if attempt < self.retries:
+                    time.sleep(0.05 * (attempt + 1))
+        raise ApiError(f"{url}: {last}") from last
+
+    # ---- nodes ---------------------------------------------------------
+
+    def nodes_with_label(self, selector: str = "") -> list[Machine]:
+        path = "nodes"
+        if selector:
+            path += "?" + urllib.parse.urlencode(
+                {"labelSelector": selector}
+            )
+        doc = self._request(path)
+        out = []
+        for item in doc.get("items", []):
+            try:
+                out.append(self._parse_node(item))
+            except (KeyError, ValueError) as e:
+                log.error("skipping unparseable node: %s", e)
+        return out
+
+    def all_nodes(self) -> list[Machine]:
+        return self.nodes_with_label("")
+
+    @staticmethod
+    def _parse_node(item: dict) -> Machine:
+        meta = item["metadata"]
+        status = item.get("status", {})
+        cap = status.get("capacity", {})
+        alloc = status.get("allocatable", cap)
+        labels = meta.get("labels", {})
+        rack = ""
+        for key in RACK_LABELS:
+            if key in labels:
+                rack = labels[key]
+                break
+        return Machine(
+            name=meta["name"],
+            cpu_capacity=parse_cpu(cap.get("cpu", "0")),
+            cpu_allocatable=parse_cpu(alloc.get("cpu", "0")),
+            memory_capacity_kb=parse_memory_kb(cap.get("memory", "0")),
+            memory_allocatable_kb=parse_memory_kb(
+                alloc.get("memory", "0")
+            ),
+            rack=rack,
+            max_tasks=int(cap.get("pods", 0) or 0),
+        )
+
+    # ---- pods ----------------------------------------------------------
+
+    def pods_with_label(self, selector: str = "") -> list[Task]:
+        path = "pods"
+        if selector:
+            path += "?" + urllib.parse.urlencode(
+                {"labelSelector": selector}
+            )
+        doc = self._request(path)
+        out = []
+        for item in doc.get("items", []):
+            try:
+                out.append(self._parse_pod(item))
+            except (KeyError, ValueError) as e:
+                log.error("skipping unparseable pod: %s", e)
+        return out
+
+    def all_pods(self) -> list[Task]:
+        return self.pods_with_label("")
+
+    @staticmethod
+    def _parse_pod(item: dict) -> Task:
+        meta = item["metadata"]
+        spec = item.get("spec", {})
+        status = item.get("status", {})
+        cpu = 0.0
+        mem_kb = 0
+        for c in spec.get("containers", []):
+            req = c.get("resources", {}).get("requests", {})
+            cpu += parse_cpu(req.get("cpu", "0"))
+            mem_kb += parse_memory_kb(req.get("memory", "0"))
+        annotations = meta.get("annotations", {})
+        prefs: dict[str, int] = {}
+        raw_prefs = annotations.get("poseidon.io/data-prefs", "")
+        if raw_prefs:
+            try:
+                prefs = {
+                    k: int(v) for k, v in json.loads(raw_prefs).items()
+                }
+            except (json.JSONDecodeError, ValueError):
+                log.error("bad data-prefs annotation on %s", meta["name"])
+        phase_raw = status.get("phase", "Unknown")
+        try:
+            phase = TaskPhase(phase_raw)
+        except ValueError:
+            phase = TaskPhase.UNKNOWN
+        return Task(
+            uid=meta["name"],
+            namespace=meta.get("namespace", "default"),
+            job=meta.get("labels", {}).get("job-name", ""),
+            cpu_request=cpu,
+            memory_request_kb=mem_kb,
+            phase=phase,
+            machine=spec.get("nodeName", "") or "",
+            data_prefs=prefs,
+        )
+
+    # ---- bindings ------------------------------------------------------
+
+    def bind_pod_to_node(
+        self, pod: str, node: str, namespace: str = "default"
+    ) -> bool:
+        """POST the binding that makes a placement real
+        (k8s_api_client.cc:67-94; body shape at :75-79)."""
+        body = {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": pod},
+            "target": {
+                "apiVersion": "v1", "kind": "Node", "name": node,
+            },
+        }
+        try:
+            self._request(f"namespaces/{namespace}/bindings", body)
+            return True
+        except ApiError as e:
+            log.error("binding %s -> %s failed: %s", pod, node, e)
+            return False

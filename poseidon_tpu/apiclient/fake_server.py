@@ -1,0 +1,229 @@
+"""In-process fake Kubernetes apiserver for hermetic end-to-end tests.
+
+The reference leaves this as a seam — all apiserver traffic goes through
+one base URI (k8s_api_client.h:61) and the JSON shapes are documented in
+comments (k8s_api_client.cc:96-99) — but never builds the fixture
+(SURVEY §4: zero tests). This serves the core-v1 subset the client uses:
+
+- ``GET /api/v1/nodes``  (optional labelSelector, exact-match subset)
+- ``GET /api/v1/pods``
+- ``POST /api/v1/namespaces/{ns}/bindings`` — applies the binding: the
+  pod's ``spec.nodeName`` is set and its phase flips to Running on the
+  NEXT poll (bindings are acknowledged before they are observable, like
+  the real control plane).
+
+Fault injection for resilience tests: ``fail_next(n)`` makes the next n
+requests return HTTP 500; ``drop_node(name)`` removes a node between
+polls (the node-removal path the reference never handled).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class FakeApiServer:
+    """Runs on a random localhost port; mutate state between polls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.nodes: dict[str, dict] = {}
+        self.pods: dict[str, dict] = {}
+        self.bindings: list[tuple[str, str]] = []
+        self._pending_bindings: list[tuple[str, str]] = []
+        self._fail_next = 0
+        self.requests_served = 0
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence
+                pass
+
+            def _reply(self, code: int, doc: dict):
+                payload = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                with server._lock:
+                    server.requests_served += 1
+                    if server._fail_next > 0:
+                        server._fail_next -= 1
+                        self._reply(500, {"error": "injected"})
+                        return
+                    url = urlparse(self.path)
+                    selector = parse_qs(url.query).get(
+                        "labelSelector", [""]
+                    )[0]
+                    if url.path == "/api/v1/nodes":
+                        items = server._select(
+                            server.nodes.values(), selector
+                        )
+                        self._reply(200, {"items": items})
+                    elif url.path == "/api/v1/pods":
+                        server._apply_pending()
+                        items = server._select(
+                            server.pods.values(), selector
+                        )
+                        self._reply(200, {"items": items})
+                    else:
+                        self._reply(404, {"error": self.path})
+
+            def do_POST(self):
+                with server._lock:
+                    server.requests_served += 1
+                    if server._fail_next > 0:
+                        server._fail_next -= 1
+                        self._reply(500, {"error": "injected"})
+                        return
+                    url = urlparse(self.path)
+                    parts = url.path.strip("/").split("/")
+                    # api/v1/namespaces/{ns}/bindings
+                    if (
+                        len(parts) == 5
+                        and parts[2] == "namespaces"
+                        and parts[4] == "bindings"
+                    ):
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        pod = body["metadata"]["name"]
+                        node = body["target"]["name"]
+                        if pod not in server.pods:
+                            self._reply(404, {"error": f"no pod {pod}"})
+                            return
+                        if node not in server.nodes:
+                            self._reply(404, {"error": f"no node {node}"})
+                            return
+                        server._pending_bindings.append((pod, node))
+                        server.bindings.append((pod, node))
+                        self._reply(201, {"status": "Bound"})
+                    else:
+                        self._reply(404, {"error": self.path})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def start(self) -> "FakeApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---- state helpers -------------------------------------------------
+
+    @staticmethod
+    def _select(items, selector: str) -> list[dict]:
+        out = list(items)
+        if selector:
+            want = dict(
+                kv.split("=", 1) for kv in selector.split(",") if "=" in kv
+            )
+            out = [
+                i for i in out
+                if all(
+                    i.get("metadata", {}).get("labels", {}).get(k) == v
+                    for k, v in want.items()
+                )
+            ]
+        return out
+
+    def _apply_pending(self) -> None:
+        """Bindings become observable on the next pods poll."""
+        for pod, node in self._pending_bindings:
+            doc = self.pods.get(pod)
+            if doc is not None:
+                doc.setdefault("spec", {})["nodeName"] = node
+                doc.setdefault("status", {})["phase"] = "Running"
+        self._pending_bindings.clear()
+
+    def add_node(
+        self,
+        name: str,
+        *,
+        cpu: str = "8",
+        memory: str = "16Gi",
+        pods: int = 10,
+        rack: str = "",
+    ) -> None:
+        labels = {"rack": rack} if rack else {}
+        with self._lock:
+            self.nodes[name] = {
+                "metadata": {"name": name, "labels": labels},
+                "status": {
+                    "capacity": {
+                        "cpu": cpu, "memory": memory, "pods": str(pods),
+                    },
+                    "allocatable": {
+                        "cpu": cpu, "memory": memory, "pods": str(pods),
+                    },
+                },
+            }
+
+    def add_pod(
+        self,
+        name: str,
+        *,
+        namespace: str = "default",
+        cpu: str = "100m",
+        memory: str = "128Mi",
+        job: str = "",
+        data_prefs: dict[str, int] | None = None,
+        phase: str = "Pending",
+        node: str = "",
+    ) -> None:
+        meta: dict = {"name": name, "namespace": namespace, "labels": {}}
+        if job:
+            meta["labels"]["job-name"] = job
+        if data_prefs:
+            meta["annotations"] = {
+                "poseidon.io/data-prefs": json.dumps(data_prefs)
+            }
+        with self._lock:
+            self.pods[name] = {
+                "metadata": meta,
+                "spec": {
+                    "containers": [
+                        {
+                            "resources": {
+                                "requests": {"cpu": cpu, "memory": memory}
+                            }
+                        }
+                    ],
+                    **({"nodeName": node} if node else {}),
+                },
+                "status": {"phase": phase},
+            }
+
+    def drop_node(self, name: str) -> None:
+        with self._lock:
+            self.nodes.pop(name, None)
+
+    def fail_next(self, n: int) -> None:
+        with self._lock:
+            self._fail_next = n
+
+    def succeed_pod(self, name: str) -> None:
+        with self._lock:
+            doc = self.pods.get(name)
+            if doc is not None:
+                doc["status"]["phase"] = "Succeeded"

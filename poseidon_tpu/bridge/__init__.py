@@ -1,0 +1,9 @@
+"""L4' — the scheduler bridge (cluster state <-> solver)."""
+
+from poseidon_tpu.bridge.bridge import (
+    RoundResult,
+    SchedulerBridge,
+    SchedulerStats,
+)
+
+__all__ = ["SchedulerBridge", "SchedulerStats", "RoundResult"]

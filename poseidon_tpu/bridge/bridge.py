@@ -1,0 +1,293 @@
+"""Scheduler bridge: pod/node state machine driving the TPU solver.
+
+The first-party core of the reference (src/firmament/scheduler_bridge.cc)
+re-expressed around ``solve_scheduling``: nodes/pods observed from the
+apiserver become the ``ClusterState`` the graph builder prices, one flow
+solve per round turns into placement deltas, and per-round statistics are
+collected instead of dropped (the reference requests ``SchedulerStats``
+and never reads it, scheduler_bridge.cc:170-172).
+
+Deliberate fixes over the reference's semantics:
+
+- **Restart reconcile.** The reference CHECK-crashes when it restarts and
+  meets an already-Running pod it has no binding for
+  (scheduler_bridge.cc:146-147, pod_to_node_map_ lookup). Here a Running
+  pod observed with a node binding is adopted as state (the apiserver is
+  the source of truth, SURVEY §5.4) and its machine's capacity is
+  discounted.
+- **Node removal.** The reference only ever adds resources
+  (scheduler_bridge.cc:81-111). Here a node that disappears from a poll
+  releases its machine; its Running pods flip back to Pending (they will
+  be re-placed) and are logged as evictions.
+- **Succeeded/Failed handling.** The reference TODO-stubs Succeeded and
+  ignores Failed (scheduler_bridge.cc:151-157). Here both retire the
+  task and free its slot.
+- **Starvation pressure.** ``wait_rounds`` grows for every pod that a
+  round leaves unscheduled, feeding the Quincy/CoCo unscheduled-cost
+  terms so parked pods eventually win a slot (the aging input the
+  round-2 advisor found dead, ADVICE.md item 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+import numpy as np
+
+from poseidon_tpu.cluster import ClusterState, Machine, Task, TaskPhase
+from poseidon_tpu.graph.builder import FlowGraphBuilder
+from poseidon_tpu.graph.decompose import extract_placements
+from poseidon_tpu.models import build_cost_inputs, get_cost_model
+from poseidon_tpu.models.knowledge import (
+    KnowledgeBase,
+    MachineSample,
+    TaskSample,
+)
+from poseidon_tpu.solver import solve_scheduling
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class SchedulerStats:
+    """Per-round statistics (the reference collects these and drops
+    them; here they are the observability surface, SURVEY §5.1/§5.5)."""
+
+    round_num: int = 0
+    pods_total: int = 0
+    pods_pending: int = 0
+    pods_placed: int = 0
+    pods_unscheduled: int = 0
+    evictions: int = 0
+    cost: int = 0
+    backend: str = ""
+    build_ms: float = 0.0
+    price_ms: float = 0.0
+    solve_ms: float = 0.0
+    decompose_ms: float = 0.0
+    total_ms: float = 0.0
+
+
+@dataclasses.dataclass
+class RoundResult:
+    """One scheduling round's output: bindings to POST + stats."""
+
+    bindings: dict[str, str]          # pod uid -> machine name (new PLACEs)
+    stats: SchedulerStats
+    unscheduled: list[str]            # pods left pending this round
+
+
+class SchedulerBridge:
+    """Owns cluster state across rounds and runs the scheduling loop."""
+
+    def __init__(
+        self,
+        cost_model: str = "quincy",
+        *,
+        max_tasks_per_machine: int = 10,
+        sample_queue_size: int = 100,
+    ):
+        self.cost_model = cost_model
+        self.max_tasks_per_machine = max_tasks_per_machine
+        self.knowledge = KnowledgeBase(queue_size=sample_queue_size)
+        self.machines: dict[str, Machine] = {}
+        self.tasks: dict[str, Task] = {}
+        self.pod_to_machine: dict[str, str] = {}
+        self.round_num = 0
+        self.warm_state = None
+        self.decision_log: list[tuple[int, str, str]] = []
+        self._evictions_this_round = 0
+
+    # ---- observation (the poll side) -----------------------------------
+
+    def observe_nodes(self, nodes: list[Machine]) -> None:
+        """Upsert machines; release the ones that disappeared."""
+        seen = set()
+        for node in nodes:
+            if node.max_tasks <= 0:
+                node = dataclasses.replace(
+                    node, max_tasks=self.max_tasks_per_machine
+                )
+            seen.add(node.name)
+            if node.name not in self.machines:
+                log.info("new node %s (rack=%s)", node.name, node.rack)
+            self.machines[node.name] = node
+            cap = max(node.cpu_capacity, 1e-9)
+            mem_cap = max(node.memory_capacity_kb, 1)
+            self.knowledge.add_machine_sample(
+                node.name,
+                MachineSample(
+                    cpu_idle=min(node.cpu_allocatable / cap, 1.0),
+                    mem_free_frac=min(
+                        node.memory_allocatable_kb / mem_cap, 1.0
+                    ),
+                ),
+            )
+        gone = set(self.machines) - seen
+        for name in gone:
+            log.warning("node %s removed; evicting its tasks", name)
+            del self.machines[name]
+            for uid, task in list(self.tasks.items()):
+                if task.machine == name:
+                    self.tasks[uid] = dataclasses.replace(
+                        task, phase=TaskPhase.PENDING, machine=""
+                    )
+                    self.pod_to_machine.pop(uid, None)
+                    self._evictions_this_round += 1
+
+    def observe_pods(self, pods: list[Task]) -> None:
+        """The reference's per-pod dispatch (scheduler_bridge.cc:132-162),
+        with restart reconcile and terminal-state retirement."""
+        seen = set()
+        for pod in pods:
+            seen.add(pod.uid)
+            known = self.tasks.get(pod.uid)
+            if pod.phase == TaskPhase.PENDING:
+                if known is None:
+                    log.info("new pending pod %s", pod.uid)
+                    self.tasks[pod.uid] = pod
+                elif (
+                    known.phase == TaskPhase.RUNNING and known.machine
+                ):
+                    # a locally-confirmed binding outlives apiserver
+                    # poll latency: the pod still reads Pending until
+                    # the watch cache catches up, and downgrading here
+                    # would re-schedule it (double-binding + the slot
+                    # discount lost)
+                    pass
+                else:
+                    # keep our aging counter across polls
+                    self.tasks[pod.uid] = dataclasses.replace(
+                        pod, wait_rounds=known.wait_rounds
+                    )
+            elif pod.phase == TaskPhase.RUNNING:
+                if known is None or known.machine != pod.machine:
+                    # restart reconcile: adopt the apiserver's binding
+                    # instead of the reference's CHECK-crash
+                    # (scheduler_bridge.cc:146-147)
+                    log.info(
+                        "adopting running pod %s on %s",
+                        pod.uid, pod.machine,
+                    )
+                self.tasks[pod.uid] = pod
+                if pod.machine:
+                    self.pod_to_machine[pod.uid] = pod.machine
+                self.knowledge.add_task_sample(
+                    pod.uid,
+                    TaskSample(
+                        cpu_usage=pod.cpu_request,
+                        mem_usage_kb=pod.memory_request_kb,
+                    ),
+                )
+            else:  # Succeeded / Failed / Unknown: retire, free the slot
+                if known is not None:
+                    log.info("retiring pod %s (%s)", pod.uid, pod.phase)
+                    self.tasks.pop(pod.uid, None)
+                    self.pod_to_machine.pop(pod.uid, None)
+        gone = set(self.tasks) - seen
+        for uid in gone:
+            self.tasks.pop(uid, None)
+            self.pod_to_machine.pop(uid, None)
+
+    # ---- the scheduling round ------------------------------------------
+
+    def cluster_state(self) -> ClusterState:
+        return ClusterState(
+            machines=list(self.machines.values()),
+            tasks=list(self.tasks.values()),
+        )
+
+    def run_scheduler(self) -> RoundResult:
+        """One round: build -> price -> solve -> deltas (the reference's
+        RunScheduler + ScheduleAllJobs, scheduler_bridge.cc:129-192)."""
+        self.round_num += 1
+        stats = SchedulerStats(round_num=self.round_num)
+        stats.evictions = self._evictions_this_round
+        self._evictions_this_round = 0
+        t_start = time.perf_counter()
+
+        cluster = self.cluster_state()
+        pending = cluster.pending()
+        stats.pods_total = len(cluster.tasks)
+        stats.pods_pending = len(pending)
+        if not self.machines or not pending:
+            stats.total_ms = (time.perf_counter() - t_start) * 1000
+            return RoundResult(bindings={}, stats=stats, unscheduled=[])
+
+        t0 = time.perf_counter()
+        net, meta = FlowGraphBuilder().build(cluster)
+        stats.build_ms = (time.perf_counter() - t0) * 1000
+
+        t0 = time.perf_counter()
+        machine_names = [m.name for m in cluster.machines]
+        inputs = build_cost_inputs(
+            net,
+            meta,
+            task_cpu_milli=np.array(
+                [int(t.cpu_request * 1000) for t in pending]
+            ),
+            task_mem_kb=np.array(
+                [t.memory_request_kb for t in pending]
+            ),
+            task_usage=self.knowledge.task_cpu_usage(
+                [t.uid for t in pending]
+            ),
+            machine_load=self.knowledge.machine_load(machine_names),
+            machine_mem_free=self.knowledge.machine_mem_free(
+                machine_names
+            ),
+        )
+        net = net.with_costs(get_cost_model(self.cost_model)(inputs))
+        stats.price_ms = (time.perf_counter() - t0) * 1000
+
+        t0 = time.perf_counter()
+        outcome = solve_scheduling(net, meta, warm=self.warm_state)
+        self.warm_state = outcome.state
+        stats.solve_ms = (time.perf_counter() - t0) * 1000
+        stats.backend = outcome.backend
+        stats.cost = outcome.cost
+
+        t0 = time.perf_counter()
+        placements = extract_placements(
+            outcome.flows, meta, np.asarray(net.src), np.asarray(net.dst)
+        )
+        stats.decompose_ms = (time.perf_counter() - t0) * 1000
+
+        bindings: dict[str, str] = {}
+        unscheduled: list[str] = []
+        for uid, machine in placements.items():
+            task = self.tasks.get(uid)
+            if task is None:
+                continue
+            if machine is None:
+                # aging: parked pods push harder next round (the
+                # Quincy/CoCo unscheduled-cost input)
+                self.tasks[uid] = dataclasses.replace(
+                    task, wait_rounds=task.wait_rounds + 1
+                )
+                unscheduled.append(uid)
+            else:
+                bindings[uid] = machine
+                self.decision_log.append((self.round_num, uid, machine))
+                log.info(
+                    "round %d: PLACE %s -> %s",
+                    self.round_num, uid, machine,
+                )
+        stats.pods_placed = len(bindings)
+        stats.pods_unscheduled = len(unscheduled)
+        stats.total_ms = (time.perf_counter() - t_start) * 1000
+        return RoundResult(
+            bindings=bindings, stats=stats, unscheduled=unscheduled
+        )
+
+    def confirm_binding(self, uid: str, machine: str) -> None:
+        """Caller reports a successful bindings POST: mark Running so the
+        next build discounts the slot even before the poll reflects it."""
+        task = self.tasks.get(uid)
+        if task is not None:
+            self.tasks[uid] = dataclasses.replace(
+                task, phase=TaskPhase.RUNNING, machine=machine
+            )
+            self.pod_to_machine[uid] = machine

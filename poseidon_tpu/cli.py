@@ -1,0 +1,172 @@
+"""L5' — the scheduling daemon: poll loop + flag surface.
+
+The reference's ``main`` (src/firmament/scheduler_integration.cc:37-68):
+an infinite loop of poll-nodes -> poll-pods -> schedule -> POST bindings
+-> sleep. Flags mirror the reference's own (scheduler_integration.cc:
+30-33, k8s_api_client.cc:39-43) plus the Firmament flagfile surface that
+matters here (deploy/poseidon.cfg, SURVEY §2.3); ``--flagfile`` reads
+gflags-style ``--name=value`` lines so the reference's config files port
+directly.
+
+Differences from the reference loop, on purpose:
+
+- a failed poll skips the tick instead of crashing (the reference's
+  pplx chains dissolve errors into logged JSON and then parse garbage);
+- the scheduler runs whenever there is anything pending, not only when
+  a NEW pod appeared (the reference's early-out at
+  scheduler_integration.cc / scheduler_bridge.cc:165-168 strands pods
+  that arrived during a failed tick);
+- successful bindings are confirmed into the bridge immediately so the
+  next round's capacity math does not depend on poll latency.
+
+Run: ``python -m poseidon_tpu.cli --k8s_apiserver_port=8080
+--flow_scheduling_cost_model=quincy --max_rounds=0``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+from poseidon_tpu.apiclient.client import ApiError, K8sApiClient
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.models import COST_MODELS
+
+log = logging.getLogger("poseidon_tpu.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="poseidon-tpu",
+        description="TPU-native flow scheduler daemon",
+        fromfile_prefix_chars="@",
+    )
+    # the reference's own flags (scheduler_integration.cc:30-33,
+    # k8s_api_client.cc:39-43)
+    p.add_argument("--polling_frequency", type=int, default=10_000_000,
+                   help="microseconds between ticks (reference default)")
+    p.add_argument("--k8s_apiserver_host", default="localhost")
+    p.add_argument("--k8s_apiserver_port", type=int, default=8080)
+    p.add_argument("--k8s_api_version", default="v1")
+    # the Firmament flagfile surface (deploy/poseidon.cfg)
+    p.add_argument("--flow_scheduling_cost_model", default="quincy",
+                   help="name or the reference's integer selector "
+                        f"(known: {sorted(COST_MODELS)})")
+    p.add_argument("--max_tasks_per_pu", type=int, default=10)
+    p.add_argument("--max_sample_queue_size", type=int, default=100)
+    p.add_argument("--run_incremental_scheduler",
+                   default="true", choices=["true", "false"],
+                   help="reuse on-HBM warm state across rounds")
+    p.add_argument("--max_solver_runtime", type=int,
+                   default=1_000_000_000,
+                   help="microseconds; bounds one solve (reference "
+                        "poseidon.cfg:14-15)")
+    p.add_argument("--logtostderr", action="store_true")
+    p.add_argument("--flagfile", default="",
+                   help="gflags-style file of --name=value lines")
+    # operational extras
+    p.add_argument("--max_rounds", type=int, default=0,
+                   help="exit after N scheduling rounds (0 = forever)")
+    p.add_argument("--stats_json", default="",
+                   help="append per-round SchedulerStats JSON lines here")
+    return p
+
+
+def read_flagfile(path: str) -> list[str]:
+    """gflags --flagfile format: one --name=value per line, # comments."""
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append(line)
+    return out
+
+
+def parse_args(argv: list[str]) -> argparse.Namespace:
+    parser = build_parser()
+    args, _ = parser.parse_known_args(argv)
+    if args.flagfile:
+        expanded = read_flagfile(args.flagfile) + list(argv)
+        args = parser.parse_args(
+            [a for a in expanded if not a.startswith("--flagfile")]
+        )
+    else:
+        args = parser.parse_args(argv)
+    return args
+
+
+def run_loop(args: argparse.Namespace) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        stream=sys.stderr if args.logtostderr else None,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    client = K8sApiClient(
+        args.k8s_apiserver_host,
+        args.k8s_apiserver_port,
+        args.k8s_api_version,
+        timeout_s=max(args.max_solver_runtime / 1e6, 1.0),
+    )
+    bridge = SchedulerBridge(
+        cost_model=args.flow_scheduling_cost_model,
+        max_tasks_per_machine=args.max_tasks_per_pu,
+        sample_queue_size=args.max_sample_queue_size,
+    )
+    incremental = args.run_incremental_scheduler == "true"
+    stats_fh = open(args.stats_json, "a") if args.stats_json else None
+
+    rounds = 0
+    try:
+        while True:
+            tick_start = time.perf_counter()
+            try:
+                nodes = client.all_nodes()
+                pods = client.all_pods()
+            except ApiError as e:
+                log.error("poll failed, skipping tick: %s", e)
+                time.sleep(args.polling_frequency / 1e6)
+                continue
+            bridge.observe_nodes(nodes)
+            bridge.observe_pods(pods)
+            if not incremental:
+                bridge.warm_state = None
+            result = bridge.run_scheduler()
+            for uid, machine in result.bindings.items():
+                task = bridge.tasks.get(uid)
+                ns = task.namespace if task else "default"
+                if client.bind_pod_to_node(uid, machine, namespace=ns):
+                    bridge.confirm_binding(uid, machine)
+            s = result.stats
+            log.info(
+                "round %d: pending=%d placed=%d unsched=%d cost=%d "
+                "backend=%s solve=%.1fms total=%.1fms",
+                s.round_num, s.pods_pending, s.pods_placed,
+                s.pods_unscheduled, s.cost, s.backend, s.solve_ms,
+                s.total_ms,
+            )
+            if stats_fh:
+                stats_fh.write(json.dumps(vars(s)) + "\n")
+                stats_fh.flush()
+            rounds += 1
+            if args.max_rounds and rounds >= args.max_rounds:
+                return 0
+            elapsed = time.perf_counter() - tick_start
+            time.sleep(
+                max(args.polling_frequency / 1e6 - elapsed, 0.0)
+            )
+    finally:
+        if stats_fh:
+            stats_fh.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = parse_args(sys.argv[1:] if argv is None else argv)
+    return run_loop(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -277,7 +277,13 @@ COST_MODEL_SELECTORS: dict[int, str] = {
 
 
 def get_cost_model(name_or_selector: str | int) -> CostModelFn:
-    """Look up a cost model by name or by the reference's integer flag."""
+    """Look up a cost model by name or by the reference's integer flag.
+
+    Digit strings count as integer selectors — the flag surface is
+    stringly typed (``--flow_scheduling_cost_model=6``, poseidon.cfg:7).
+    """
+    if isinstance(name_or_selector, str) and name_or_selector.isdigit():
+        name_or_selector = int(name_or_selector)
     if isinstance(name_or_selector, int):
         try:
             name = COST_MODEL_SELECTORS[name_or_selector]

@@ -29,6 +29,7 @@ from poseidon_tpu.ops.dense_auction import (
     DenseInstance,
     _solve,
     build_dense_instance,
+    cold_start,
 )
 from poseidon_tpu.ops.transport import TransportInstance
 
@@ -53,10 +54,7 @@ def _solve_batch(c, u, w, dgen, cmax, s, task_valid, scale,
             c=c1, u=u1, w=w1, dgen=dg1, s=s, task_valid=task_valid,
             scale=scale, cmax=cm1, smax=smax,
         )
-        asg0 = jnp.where(task_valid, -1, Mp).astype(I32)
-        lvl0 = jnp.zeros(Tp, I32)
-        floor0 = jnp.zeros(Mp, I32)
-        eps0 = jnp.maximum(cm1 // alpha, 1)
+        asg0, lvl0, floor0, eps0 = cold_start(dev, alpha)
         asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
             dev, asg0, lvl0, floor0, eps0, alpha=alpha,
             max_rounds=max_rounds, smax=smax, analytic_init=True,
@@ -104,6 +102,18 @@ def perturb_costs(
         )
         return y.astype(I32)
 
+    # split the dense table into its generic part (w + dgen, which the
+    # analytic clearing init reads) and the pref overlay, so jittered
+    # variants keep the c == min(w + dgen, prefs) invariant the init
+    # relies on — independently jittered w/dgen would seat tasks at
+    # levels inconsistent with the prices c actually charges
+    generic = jnp.minimum(
+        inst_dev.w[:, None].astype(jnp.int64)
+        + inst_dev.dgen[None, :].astype(jnp.int64),
+        jnp.int64(INF),
+    ).astype(I32)
+    pref_part = jnp.where(inst_dev.c < generic, inst_dev.c, INF)
+
     cs, us, ws, ds = [], [], [], []
     for b in range(n_variants):
         if b == 0:
@@ -114,10 +124,21 @@ def perturb_costs(
         else:
             kb = jax.random.fold_in(key, b)
             k1, k2, k3, k4 = jax.random.split(kb, 4)
-            cs.append(jitter(k1, inst_dev.c))
-            us.append(jitter(k2, inst_dev.u))
-            ws.append(jitter(k3, inst_dev.w))
-            ds.append(jitter(k4, inst_dev.dgen))
+            w_b = jitter(k1, inst_dev.w)
+            d_b = jitter(k2, inst_dev.dgen)
+            p_b = jitter(k3, pref_part)
+            g_b = jnp.minimum(
+                w_b[:, None].astype(jnp.int64)
+                + d_b[None, :].astype(jnp.int64),
+                jnp.int64(INF),
+            ).astype(I32)
+            c_b = jnp.where(
+                inst_dev.s[None, :] > 0, jnp.minimum(g_b, p_b), INF
+            )
+            cs.append(c_b)
+            us.append(jitter(k4, inst_dev.u))
+            ws.append(w_b)
+            ds.append(d_b)
     c = jnp.stack(cs)
     u = jnp.stack(us)
     w = jnp.stack(ws)

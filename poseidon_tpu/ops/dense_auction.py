@@ -600,6 +600,16 @@ def _solve(
     return asg, lvl, floor, gap, converged, rounds, phases, hist
 
 
+def cold_start(inst_dev: DenseInstance, alpha: int = 4):
+    """Canonical cold-start state: (asg0, lvl0, floor0, eps0)."""
+    Tp, Mp = inst_dev.c.shape
+    asg0 = jnp.where(inst_dev.task_valid, -1, Mp).astype(I32)
+    lvl0 = jnp.zeros(Tp, I32)
+    floor0 = jnp.zeros(Mp, I32)
+    eps0 = jnp.maximum(inst_dev.cmax // alpha, 1)
+    return asg0, lvl0, floor0, eps0
+
+
 def solve_dense(
     inst_dev: DenseInstance,
     *,
@@ -626,10 +636,7 @@ def solve_dense(
     if analytic:
         # placeholders; the kernel's analytic clearing start replaces
         # them (keeping one compiled program for the cold path)
-        asg0 = jnp.where(inst_dev.task_valid, -1, Mp).astype(I32)
-        lvl0 = jnp.zeros(Tp, I32)
-        floor0 = jnp.zeros(Mp, I32)
-        eps0 = jnp.maximum(inst_dev.cmax // alpha, 1)
+        asg0, lvl0, floor0, eps0 = cold_start(inst_dev, alpha)
     else:
         asg0 = warm.asg
         lvl0 = warm.lvl

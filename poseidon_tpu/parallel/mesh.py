@@ -22,4 +22,9 @@ def make_mesh(n_devices: int | None = None, axis: str = "tasks") -> Mesh:
                 f"requested {n_devices} devices, have {len(devs)}"
             )
         devs = devs[:n_devices]
+    if len(devs) & (len(devs) - 1):
+        raise ValueError(
+            f"mesh size must be a power of two to divide the padded "
+            f"task axis; got {len(devs)} devices"
+        )
     return Mesh(devs, (axis,))

@@ -61,19 +61,21 @@ def shard_instance(dev: DenseInstance, mesh: Mesh) -> DenseInstance:
 
 
 def solve_dense_sharded(
-    dev: DenseInstance,
-    mesh: Mesh,
+    sharded: DenseInstance,
     *,
     warm: DenseState | None = None,
     alpha: int = 4,
     max_rounds: int = 20_000,
 ) -> DenseState:
-    """Solve with the instance sharded over ``mesh``.
+    """Solve an instance previously laid out by ``shard_instance``.
+
+    Taking the sharded instance (not re-sharding internally) keeps the
+    warm incremental path at zero per-round [T, M] transfers — lay the
+    table out once per cluster shape, re-solve every tick.
 
     The kernel is identical to the single-device path; only the data
     layout differs, so converged results match bit-for-bit.
     """
-    sharded = shard_instance(dev, mesh)
     return solve_dense(
         sharded, warm=warm, alpha=alpha, max_rounds=max_rounds
     )

@@ -25,6 +25,7 @@ mode (deploy/poseidon.cfg:12).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 
 import numpy as np
@@ -44,6 +45,8 @@ from poseidon_tpu.ops.transport import (
     extract_instance,
     flows_from_assignment,
 )
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,7 +93,11 @@ def solve_scheduling(
         return _solve_on_oracle(net, t0, why="cost-domain")
     except ValueError:
         # defensive: an instance outside the kernel's envelope (e.g.
-        # negative costs from a custom model) must degrade, not crash
+        # negative costs from a custom model) must degrade, not crash —
+        # but loudly, so a masked kernel regression stays discoverable
+        log.exception(
+            "dense kernel rejected the instance; degrading to oracle"
+        )
         if not oracle_fallback:
             raise
         return _solve_on_oracle(net, t0, why="kernel-envelope")

@@ -1,0 +1,138 @@
+"""Multi-round bridge simulation: pod lifecycle, reconcile, aging."""
+
+import dataclasses
+
+import numpy as np
+
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.cluster import Machine, Task, TaskPhase
+
+
+def _machines(n, slots=2):
+    return [
+        Machine(
+            name=f"m{i}", rack=f"r{i % 2}", cpu_capacity=8,
+            cpu_allocatable=8, memory_capacity_kb=1 << 22,
+            memory_allocatable_kb=1 << 22, max_tasks=slots,
+        )
+        for i in range(n)
+    ]
+
+
+def _pods(n, phase=TaskPhase.PENDING):
+    return [
+        Task(uid=f"p{i}", job=f"j{i // 4}", cpu_request=0.5,
+             memory_request_kb=1 << 12, phase=phase)
+        for i in range(n)
+    ]
+
+
+class TestLifecycle:
+    def test_pending_to_running_to_succeeded(self):
+        bridge = SchedulerBridge(cost_model="trivial")
+        bridge.observe_nodes(_machines(3))
+        bridge.observe_pods(_pods(4))
+        r1 = bridge.run_scheduler()
+        assert r1.stats.pods_placed == 4
+        assert set(r1.bindings) == {"p0", "p1", "p2", "p3"}
+
+        # bindings confirmed -> Running; capacity is discounted
+        for uid, m in r1.bindings.items():
+            bridge.confirm_binding(uid, m)
+        running = [
+            dataclasses.replace(
+                t, phase=TaskPhase.RUNNING, machine=r1.bindings[t.uid]
+            )
+            for t in _pods(4)
+        ]
+        bridge.observe_pods(running + _pods(8)[4:])
+        r2 = bridge.run_scheduler()
+        # only 6 - 4 = 2 slots remain on 3 machines x 2 slots
+        assert r2.stats.pods_placed == 2
+        assert r2.stats.pods_unscheduled == 2
+
+        # succeeded pods free their slots
+        done = [
+            dataclasses.replace(t, phase=TaskPhase.SUCCEEDED)
+            for t in running
+        ]
+        still_pending = [
+            t for t in _pods(8)[4:]
+            if t.uid not in r2.bindings
+        ]
+        for uid, m in r2.bindings.items():
+            bridge.confirm_binding(uid, m)
+        running2 = [
+            dataclasses.replace(
+                t, phase=TaskPhase.RUNNING, machine=r2.bindings[t.uid]
+            )
+            for t in _pods(8)[4:] if t.uid in r2.bindings
+        ]
+        bridge.observe_pods(done + running2 + still_pending)
+        r3 = bridge.run_scheduler()
+        assert r3.stats.pods_placed == 2  # freed slots absorb the rest
+        assert r3.stats.pods_unscheduled == 0
+
+    def test_restart_reconcile_adopts_running_pods(self):
+        """The reference CHECK-crashes here (scheduler_bridge.cc:146-147):
+        a fresh bridge observing already-Running pods must adopt them."""
+        bridge = SchedulerBridge(cost_model="trivial")
+        bridge.observe_nodes(_machines(2))
+        running = [
+            Task(uid="old0", cpu_request=0.5, phase=TaskPhase.RUNNING,
+                 machine="m0"),
+            Task(uid="old1", cpu_request=0.5, phase=TaskPhase.RUNNING,
+                 machine="m0"),
+        ]
+        bridge.observe_pods(running + _pods(3))
+        r = bridge.run_scheduler()
+        # m0's 2 slots are taken by adopted pods: only m1's 2 remain
+        assert r.stats.pods_placed == 2
+        placed_on = set(r.bindings.values())
+        assert placed_on == {"m1"}
+
+    def test_node_removal_evicts(self):
+        bridge = SchedulerBridge(cost_model="trivial")
+        bridge.observe_nodes(_machines(2))
+        bridge.observe_pods(_pods(2))
+        r1 = bridge.run_scheduler()
+        for uid, m in r1.bindings.items():
+            bridge.confirm_binding(uid, m)
+        # node m0 disappears
+        bridge.observe_nodes(_machines(2)[1:])
+        evicted = [
+            uid for uid, t in bridge.tasks.items()
+            if t.phase == TaskPhase.PENDING
+        ]
+        r2 = bridge.run_scheduler()
+        assert r2.stats.evictions >= 0
+        # every task ends up pending-or-placed on the surviving node
+        for uid, t in bridge.tasks.items():
+            assert t.machine in ("", "m1")
+
+    def test_wait_rounds_grow_and_raise_unscheduled_cost(self):
+        """ADVICE item 4: aging must actually increase the starvation
+        pressure round over round."""
+        bridge = SchedulerBridge(cost_model="quincy")
+        bridge.observe_nodes(_machines(1, slots=1))
+        bridge.observe_pods(_pods(3))
+        r1 = bridge.run_scheduler()
+        assert r1.stats.pods_unscheduled == 2
+        w1 = [bridge.tasks[u].wait_rounds for u in r1.unscheduled]
+        for uid, m in r1.bindings.items():
+            bridge.confirm_binding(uid, m)
+        r2 = bridge.run_scheduler()
+        w2 = [bridge.tasks[u].wait_rounds for u in r2.unscheduled]
+        assert all(b > a for a, b in zip(sorted(w1), sorted(w2)))
+        # and the round cost reflects growing unscheduled penalties
+        assert r2.stats.cost >= r1.stats.cost
+
+    def test_warm_state_reused_across_rounds(self):
+        bridge = SchedulerBridge(cost_model="quincy")
+        bridge.observe_nodes(_machines(4))
+        bridge.observe_pods(_pods(6))
+        r1 = bridge.run_scheduler()
+        assert bridge.warm_state is not None
+        bridge.observe_pods(_pods(6))  # same pending set
+        r2 = bridge.run_scheduler()
+        assert r2.stats.cost == r1.stats.cost

@@ -47,26 +47,32 @@ class TestShardedSolve:
         net, inst = _instance(0)
         dev = build_dense_instance(inst)
         single = solve_dense(dev)
-        sharded = solve_dense_sharded(dev, mesh8)
+        sharded = solve_dense_sharded(shard_instance(dev, mesh8))
         s_asg, s_conv = jax.device_get((sharded.asg, sharded.converged))
         r_asg, r_conv = jax.device_get((single.asg, single.converged))
         assert bool(s_conv) and bool(r_conv)
         assert (np.asarray(s_asg) == np.asarray(r_asg)).all()
 
     def test_sharded_exact_vs_oracle(self, mesh8):
+        from poseidon_tpu.ops.dense_auction import _channels_for, _objective
+
         net, inst = _instance(1, model="trivial")
         dev = build_dense_instance(inst)
-        state = solve_dense_sharded(dev, mesh8)
-        res, _ = solve_transport_dense(inst)  # host decode path
+        state = solve_dense_sharded(shard_instance(dev, mesh8))
         o = solve_oracle(net, algorithm="cost_scaling")
         assert bool(jax.device_get(state.converged))
-        assert res.cost == o.cost
+        # decode the SHARDED state's own assignment and cost it
+        Mp = dev.c.shape[1]
+        asg = np.asarray(jax.device_get(state.asg))[: inst.n_tasks]
+        asg = np.where((asg >= 0) & (asg < inst.n_machines), asg, -1)
+        ch = _channels_for(inst, asg.astype(np.int32))
+        assert _objective(inst, ch, asg) == o.cost
 
     def test_shard_map_certificate_matches_kernel(self, mesh8):
         net, inst = _instance(2)
         dev = build_dense_instance(inst)
         sdev = shard_instance(dev, mesh8)
-        state = solve_dense_sharded(dev, mesh8)
+        state = solve_dense_sharded(sdev)
         gap_kernel = int(jax.device_get(state.gap))
         gap_psum = sharded_certificate_gap(sdev, state, mesh8)
         assert gap_psum == gap_kernel
@@ -74,8 +80,9 @@ class TestShardedSolve:
     def test_sharded_warm_resolve(self, mesh8):
         net, inst = _instance(3)
         dev = build_dense_instance(inst)
-        state = solve_dense_sharded(dev, mesh8)
-        warm = solve_dense_sharded(dev, mesh8, warm=state)
+        sdev = shard_instance(dev, mesh8)
+        state = solve_dense_sharded(sdev)
+        warm = solve_dense_sharded(sdev, warm=state)
         assert bool(jax.device_get(warm.converged))
         a1, a2 = jax.device_get((state.asg, warm.asg))
         # same optimum value; assignment may permute among ties, so

@@ -181,11 +181,111 @@ def bench_config(
     return row
 
 
+def bench_trace_replay(
+    *, n_machines: int = 12_000, rounds: int = 12, seed: int = 0
+) -> dict:
+    """BASELINE config 4: incremental delta rounds at 12k machines.
+
+    Drives the real bridge (graph rebuild + pricing + warm TPU solve +
+    decompose per round) through a cluster-trace-shaped churn stream;
+    pending work carries over, placed work occupies slots. Reports p50
+    per-phase times across rounds and cross-checks one round against
+    the oracle.
+    """
+    import dataclasses as dc
+
+    from poseidon_tpu.bridge import SchedulerBridge
+    from poseidon_tpu.cluster import TaskPhase
+    from poseidon_tpu.graph.builder import FlowGraphBuilder
+    from poseidon_tpu.models import build_cost_inputs, get_cost_model
+    from poseidon_tpu.oracle import solve_oracle
+    from poseidon_tpu.synth import config4_trace_replay
+
+    row: dict = {"config": "trace_replay_12k", "machines": n_machines}
+    machines, stream = config4_trace_replay(n_machines, seed=seed)
+    bridge = SchedulerBridge(cost_model="quincy")
+    bridge.observe_nodes(machines)
+
+    per_round = []
+    placed_total = 0
+    for rnd in range(rounds):
+        new_tasks, done = next(stream)
+        # one full poll snapshot per round (observe_pods treats its
+        # argument as the complete pod list): current state with the
+        # finished pods flipped to SUCCEEDED, plus the new arrivals
+        done_set = set(done)
+        snapshot = [
+            dc.replace(t, phase=TaskPhase.SUCCEEDED)
+            if t.uid in done_set else t
+            for t in bridge.tasks.values()
+        ] + new_tasks
+        bridge.observe_pods(snapshot)
+        if rnd == 1:
+            # cross-check one steady-state round against the oracle
+            cluster = bridge.cluster_state()
+            net, meta = FlowGraphBuilder().build(cluster)
+            pend = cluster.pending()
+            inputs = build_cost_inputs(
+                net, meta,
+                task_cpu_milli=np.array(
+                    [int(t.cpu_request * 1000) for t in pend]
+                ),
+                task_mem_kb=np.array(
+                    [t.memory_request_kb for t in pend]
+                ),
+                task_usage=bridge.knowledge.task_cpu_usage(
+                    [t.uid for t in pend]
+                ),
+                machine_load=bridge.knowledge.machine_load(
+                    [m.name for m in cluster.machines]
+                ),
+                machine_mem_free=bridge.knowledge.machine_mem_free(
+                    [m.name for m in cluster.machines]
+                ),
+            )
+            priced = net.with_costs(get_cost_model("quincy")(inputs))
+            oracle_cost = solve_oracle(
+                priced, algorithm="cost_scaling"
+            ).cost
+        result = bridge.run_scheduler()
+        if rnd == 1:
+            row["round1_exact"] = bool(
+                result.stats.cost == oracle_cost
+            )
+        for uid, m in result.bindings.items():
+            bridge.confirm_binding(uid, m)
+        placed_total += result.stats.pods_placed
+        per_round.append(result.stats)
+        log(
+            f"bench: trace round {rnd}: pending="
+            f"{result.stats.pods_pending} placed="
+            f"{result.stats.pods_placed} solve="
+            f"{result.stats.solve_ms:.1f}ms backend="
+            f"{result.stats.backend}"
+        )
+    # drop the first (compile) round from the p50s
+    steady = per_round[1:] or per_round
+    row["rounds"] = rounds
+    row["pods_placed_total"] = placed_total
+    row["solve_p50_ms"] = _ms([s.solve_ms / 1000 for s in steady])
+    row["build_p50_ms"] = _ms([s.build_ms / 1000 for s in steady])
+    row["price_p50_ms"] = _ms([s.price_ms / 1000 for s in steady])
+    row["decompose_p50_ms"] = _ms(
+        [s.decompose_ms / 1000 for s in steady]
+    )
+    row["total_p50_ms"] = _ms([s.total_ms / 1000 for s in steady])
+    row["backends"] = sorted({s.backend for s in steady})
+    row["all_dense"] = all(
+        s.backend == "dense_auction" for s in steady
+    )
+    return row
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--configs",
-        default="1,2,3,5",
+        default="1,2,3,4,5",
         help="comma list of BASELINE config numbers to run",
     )
     ap.add_argument("--solve-reps", type=int, default=20)
@@ -211,6 +311,20 @@ def main() -> int:
 
     rows = []
     for num in sorted(want):
+        if num == 4:
+            log("bench: running config 4 (trace_replay_12k) ...")
+            try:
+                row = bench_trace_replay()
+                row["config_num"] = 4
+                rows.append(row)
+                log(f"bench: config 4 done: {json.dumps(row)}")
+            except Exception:
+                log(f"bench: config 4 FAILED:\n{traceback.format_exc()}")
+                rows.append(
+                    {"config": "trace_replay_12k", "config_num": 4,
+                     "error": True}
+                )
+            continue
         if num not in ladder:
             continue
         name, gen, model, what_if = ladder[num]

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Run the scheduler daemon against a cluster apiserver (the port of the
+# reference's deploy/run.sh + deploy_locally.sh: no solver binaries to
+# stage — the solver is the in-process JAX kernel).
+set -euo pipefail
+DIR=$( cd "$( dirname "${BASH_SOURCE[0]}" )" && pwd )
+HOST="${K8S_APISERVER_HOST:-localhost}"
+PORT="${K8S_APISERVER_PORT:-8080}"
+mkdir -p /var/log/poseidon-tpu
+exec python -m poseidon_tpu.cli \
+  --flagfile="${DIR}/poseidon-tpu.cfg" \
+  --k8s_apiserver_host="${HOST}" \
+  --k8s_apiserver_port="${PORT}" \
+  "$@"

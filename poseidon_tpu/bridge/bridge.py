@@ -46,6 +46,7 @@ from poseidon_tpu.models.knowledge import (
     TaskSample,
 )
 from poseidon_tpu.solver import solve_scheduling
+from poseidon_tpu.trace import TraceGenerator
 
 log = logging.getLogger(__name__)
 
@@ -88,9 +89,13 @@ class SchedulerBridge:
         *,
         max_tasks_per_machine: int = 10,
         sample_queue_size: int = 100,
+        trace: TraceGenerator | None = None,
+        solver_timeout_s: float = 1000.0,
     ):
         self.cost_model = cost_model
+        self.solver_timeout_s = solver_timeout_s
         self.max_tasks_per_machine = max_tasks_per_machine
+        self.trace = trace or TraceGenerator()
         self.knowledge = KnowledgeBase(queue_size=sample_queue_size)
         self.machines: dict[str, Machine] = {}
         self.tasks: dict[str, Task] = {}
@@ -135,6 +140,8 @@ class SchedulerBridge:
                         task, phase=TaskPhase.PENDING, machine=""
                     )
                     self.pod_to_machine.pop(uid, None)
+                    self.trace.emit("EVICT", task=uid, machine=name,
+                                    round_num=self.round_num)
                     self._evictions_this_round += 1
 
     def observe_pods(self, pods: list[Task]) -> None:
@@ -147,6 +154,8 @@ class SchedulerBridge:
             if pod.phase == TaskPhase.PENDING:
                 if known is None:
                     log.info("new pending pod %s", pod.uid)
+                    self.trace.emit("SUBMIT", task=pod.uid,
+                                    round_num=self.round_num)
                     self.tasks[pod.uid] = pod
                 elif (
                     known.phase == TaskPhase.RUNNING and known.machine
@@ -184,6 +193,10 @@ class SchedulerBridge:
             else:  # Succeeded / Failed / Unknown: retire, free the slot
                 if known is not None:
                     log.info("retiring pod %s (%s)", pod.uid, pod.phase)
+                    self.trace.emit("FINISH", task=pod.uid,
+                                    machine=known.machine,
+                                    round_num=self.round_num,
+                                    detail={"phase": str(pod.phase.value)})
                     self.tasks.pop(pod.uid, None)
                     self.pod_to_machine.pop(pod.uid, None)
         gone = set(self.tasks) - seen
@@ -214,6 +227,11 @@ class SchedulerBridge:
         stats.pods_pending = len(pending)
         if not self.machines or not pending:
             stats.total_ms = (time.perf_counter() - t_start) * 1000
+            self.trace.emit(
+                "ROUND", round_num=self.round_num,
+                detail=dataclasses.asdict(stats),
+            )
+            self.trace.flush()
             return RoundResult(bindings={}, stats=stats, unscheduled=[])
 
         t0 = time.perf_counter()
@@ -243,7 +261,10 @@ class SchedulerBridge:
         stats.price_ms = (time.perf_counter() - t0) * 1000
 
         t0 = time.perf_counter()
-        outcome = solve_scheduling(net, meta, warm=self.warm_state)
+        outcome = solve_scheduling(
+            net, meta, warm=self.warm_state,
+            oracle_timeout_s=self.solver_timeout_s,
+        )
         self.warm_state = outcome.state
         stats.solve_ms = (time.perf_counter() - t0) * 1000
         stats.backend = outcome.backend
@@ -271,6 +292,8 @@ class SchedulerBridge:
             else:
                 bindings[uid] = machine
                 self.decision_log.append((self.round_num, uid, machine))
+                self.trace.emit("SCHEDULE", task=uid, machine=machine,
+                                round_num=self.round_num)
                 log.info(
                     "round %d: PLACE %s -> %s",
                     self.round_num, uid, machine,
@@ -278,6 +301,11 @@ class SchedulerBridge:
         stats.pods_placed = len(bindings)
         stats.pods_unscheduled = len(unscheduled)
         stats.total_ms = (time.perf_counter() - t_start) * 1000
+        self.trace.emit(
+            "ROUND", round_num=self.round_num,
+            detail=dataclasses.asdict(stats),
+        )
+        self.trace.flush()
         return RoundResult(
             bindings=bindings, stats=stats, unscheduled=unscheduled
         )

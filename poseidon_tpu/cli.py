@@ -62,8 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reuse on-HBM warm state across rounds")
     p.add_argument("--max_solver_runtime", type=int,
                    default=1_000_000_000,
-                   help="microseconds; bounds one solve (reference "
-                        "poseidon.cfg:14-15)")
+                   help="microseconds; bounds one oracle-fallback solve "
+                        "(the TPU kernel is bounded by its round fuse; "
+                        "reference poseidon.cfg:14-15)")
     p.add_argument("--logtostderr", action="store_true")
     p.add_argument("--flagfile", default="",
                    help="gflags-style file of --name=value lines")
@@ -72,6 +73,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="exit after N scheduling rounds (0 = forever)")
     p.add_argument("--stats_json", default="",
                    help="append per-round SchedulerStats JSON lines here")
+    p.add_argument("--trace_log", default="",
+                   help="append cluster-trace-style scheduler events "
+                        "(SUBMIT/SCHEDULE/EVICT/FINISH/ROUND) here")
     return p
 
 
@@ -86,14 +90,31 @@ def read_flagfile(path: str) -> list[str]:
     return out
 
 
+def _strip_flagfile(tokens: list[str]) -> list[str]:
+    """Remove --flagfile=X and the two-token --flagfile X forms."""
+    out = []
+    skip = False
+    for tok in tokens:
+        if skip:
+            skip = False
+            continue
+        if tok == "--flagfile":
+            skip = True
+            continue
+        if tok.startswith("--flagfile="):
+            continue
+        out.append(tok)
+    return out
+
+
 def parse_args(argv: list[str]) -> argparse.Namespace:
     parser = build_parser()
     args, _ = parser.parse_known_args(argv)
     if args.flagfile:
-        expanded = read_flagfile(args.flagfile) + list(argv)
-        args = parser.parse_args(
-            [a for a in expanded if not a.startswith("--flagfile")]
+        expanded = read_flagfile(args.flagfile) + _strip_flagfile(
+            list(argv)
         )
+        args = parser.parse_args(expanded)
     else:
         args = parser.parse_args(argv)
     return args
@@ -109,12 +130,21 @@ def run_loop(args: argparse.Namespace) -> int:
         args.k8s_apiserver_host,
         args.k8s_apiserver_port,
         args.k8s_api_version,
-        timeout_s=max(args.max_solver_runtime / 1e6, 1.0),
+        timeout_s=10.0,
     )
+    trace = None
+    trace_fh = None
+    if args.trace_log:
+        from poseidon_tpu.trace import TraceGenerator
+
+        trace_fh = open(args.trace_log, "a")
+        trace = TraceGenerator(sink=trace_fh)
     bridge = SchedulerBridge(
         cost_model=args.flow_scheduling_cost_model,
         max_tasks_per_machine=args.max_tasks_per_pu,
         sample_queue_size=args.max_sample_queue_size,
+        trace=trace,
+        solver_timeout_s=args.max_solver_runtime / 1e6,
     )
     incremental = args.run_incremental_scheduler == "true"
     stats_fh = open(args.stats_json, "a") if args.stats_json else None
@@ -161,6 +191,8 @@ def run_loop(args: argparse.Namespace) -> int:
     finally:
         if stats_fh:
             stats_fh.close()
+        if trace_fh:
+            trace_fh.close()
 
 
 def main(argv: list[str] | None = None) -> int:

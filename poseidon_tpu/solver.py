@@ -68,6 +68,7 @@ def solve_scheduling(
     *,
     warm: DenseState | None = None,
     oracle_fallback: bool = True,
+    oracle_timeout_s: float = 1000.0,
 ) -> SolveOutcome:
     """Solve a priced scheduling network exactly; prefer the TPU kernel.
 
@@ -83,14 +84,14 @@ def solve_scheduling(
     except NotSchedulingShaped:
         if not oracle_fallback:
             raise
-        return _solve_on_oracle(net, t0, why="not-scheduling-shaped")
+        return _solve_on_oracle(net, t0, why="not-scheduling-shaped", timeout_s=oracle_timeout_s)
 
     try:
         res, state = solve_transport_dense(inst, warm=warm)
     except CostDomainTooLarge:
         if not oracle_fallback:
             raise
-        return _solve_on_oracle(net, t0, why="cost-domain")
+        return _solve_on_oracle(net, t0, why="cost-domain", timeout_s=oracle_timeout_s)
     except ValueError:
         # defensive: an instance outside the kernel's envelope (e.g.
         # negative costs from a custom model) must degrade, not crash —
@@ -100,7 +101,7 @@ def solve_scheduling(
         )
         if not oracle_fallback:
             raise
-        return _solve_on_oracle(net, t0, why="kernel-envelope")
+        return _solve_on_oracle(net, t0, why="kernel-envelope", timeout_s=oracle_timeout_s)
     if not res.converged and warm is not None:
         # a stale warm start can strand the eps=1 settle; retry cold
         res, state = solve_transport_dense(inst, warm=None)
@@ -120,13 +121,15 @@ def solve_scheduling(
             f"dense auction did not certify (gap still open after "
             f"{res.rounds} rounds) and oracle fallback is disabled"
         )
-    return _solve_on_oracle(net, t0, why="uncertified")
+    return _solve_on_oracle(net, t0, why="uncertified", timeout_s=oracle_timeout_s)
 
 
-def _solve_on_oracle(net: FlowNetwork, t0: float, why: str) -> SolveOutcome:
+def _solve_on_oracle(
+    net: FlowNetwork, t0: float, why: str, timeout_s: float = 1000.0
+) -> SolveOutcome:
     from poseidon_tpu.oracle import solve_oracle
 
-    o = solve_oracle(net, algorithm="cost_scaling")
+    o = solve_oracle(net, algorithm="cost_scaling", timeout_s=timeout_s)
     return SolveOutcome(
         flows=np.asarray(o.flows, np.int32),
         cost=int(o.cost),

@@ -102,3 +102,71 @@ def config3_coco(seed: int = 0) -> ClusterState:
     """BASELINE config 3: CoCo interference, 1k nodes."""
     return make_synthetic_cluster(1000, 8000, seed=seed, prefs_per_task=1,
                                   running_fraction=0.2)
+
+
+def config4_trace_replay(
+    n_machines: int = 12_000,
+    *,
+    seed: int = 0,
+    arrivals_per_round: int = 500,
+    finish_fraction: float = 0.3,
+):
+    """BASELINE config 4: cluster-trace-style replay (12k machines).
+
+    Returns (machines, round_iter) where round_iter yields per-round
+    (new_tasks, finished_uids): a churn stream shaped like cluster-trace
+    replays — bursts of arrivals, a fraction of running work finishing
+    each round — to drive the bridge's incremental re-solve path. The
+    real Google trace is not redistributable; the statistics here (job
+    sizes, arrival burstiness) follow its published shape: many small
+    jobs, a heavy tail.
+    """
+    rng = np.random.default_rng(seed)
+    base = make_synthetic_cluster(
+        n_machines, 0, seed=seed, machines_per_rack=40,
+        max_tasks_per_machine=10,
+    )
+    machines = base.machines
+
+    def rounds():
+        counter = 0
+        running: list[str] = []
+        while True:
+            # bursty arrivals: heavy-tailed job sizes
+            n_arrive = max(1, int(rng.poisson(arrivals_per_round)))
+            new_tasks = []
+            while n_arrive > 0:
+                job_size = min(int(rng.pareto(1.5)) + 1, 64, n_arrive)
+                job = f"tracejob-{counter}"
+                for _ in range(job_size):
+                    uid = f"tracepod-{counter:07d}"
+                    counter += 1
+                    prefs = {}
+                    if rng.random() < 0.4:
+                        m = int(rng.integers(0, n_machines))
+                        prefs[machines[m].name] = int(
+                            rng.integers(20, 200)
+                        )
+                    new_tasks.append(
+                        Task(
+                            uid=uid, job=job,
+                            cpu_request=float(
+                                rng.choice([0.1, 0.25, 0.5, 1.0])
+                            ),
+                            memory_request_kb=int(
+                                rng.choice([1, 2, 8])
+                            ) << 18,
+                            data_prefs=prefs,
+                        )
+                    )
+                n_arrive -= job_size
+            # a fraction of running work finishes
+            n_done = int(len(running) * finish_fraction)
+            done = [
+                running.pop(int(rng.integers(0, len(running))))
+                for _ in range(n_done)
+            ]
+            running.extend(t.uid for t in new_tasks)
+            yield new_tasks, done
+
+    return machines, rounds()

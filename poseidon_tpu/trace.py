@@ -1,0 +1,74 @@
+"""Scheduler event trace: the TraceGenerator analog.
+
+The reference wires a ``TraceGenerator`` + injected ``WallTime`` into its
+scheduler so Firmament can emit Google-cluster-trace-style event logs
+(reference src/firmament/scheduler_bridge.{h,cc}:29,31,36,42; SURVEY
+§5.1). Here the trace is a first-class JSONL stream: one object per
+scheduler event, with an injectable clock so tests are deterministic.
+
+Event types mirror the cluster-trace vocabulary: SUBMIT (pod observed),
+SCHEDULE (placement decision), EVICT (node loss), FINISH (pod retired),
+plus ROUND records carrying the per-phase timing/stat payload.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import time
+from typing import Callable, IO
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    timestamp_us: int
+    event: str              # SUBMIT | SCHEDULE | EVICT | FINISH | ROUND
+    task: str = ""
+    machine: str = ""
+    round_num: int = 0
+    detail: dict | None = None
+
+
+class TraceGenerator:
+    """Appends one JSON object per line to ``sink`` (file-like)."""
+
+    def __init__(
+        self,
+        sink: IO[str] | None = None,
+        clock_us: Callable[[], int] | None = None,
+        buffer_events: int = 10_000,
+    ):
+        self.sink = sink
+        self.clock_us = clock_us or (lambda: int(time.time() * 1e6))
+        # with no sink, keep a bounded ring (a daemon running forever
+        # must not accumulate events without bound)
+        self.events: collections.deque[TraceEvent] = collections.deque(
+            maxlen=buffer_events
+        )
+
+    def emit(
+        self,
+        event: str,
+        *,
+        task: str = "",
+        machine: str = "",
+        round_num: int = 0,
+        detail: dict | None = None,
+    ) -> None:
+        ev = TraceEvent(
+            timestamp_us=self.clock_us(),
+            event=event,
+            task=task,
+            machine=machine,
+            round_num=round_num,
+            detail=detail,
+        )
+        if self.sink is not None:
+            self.sink.write(json.dumps(dataclasses.asdict(ev)) + "\n")
+        else:
+            self.events.append(ev)
+
+    def flush(self) -> None:
+        if self.sink is not None:
+            self.sink.flush()

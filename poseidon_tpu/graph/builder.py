@@ -118,73 +118,106 @@ class FlowGraphBuilder:
         node_role[task_base:] = NodeRole.TASK
 
         node_machine = np.full(n_nodes, -1, dtype=np.int32)
-        for i in range(M):
-            node_machine[machine_base + i] = i
+        node_machine[machine_base:unsched_base] = np.arange(
+            M, dtype=np.int32
+        )
 
-        src: list[int] = []
-        dst: list[int] = []
-        cap: list[int] = []
-        kind: list[int] = []
-        a_task: list[int] = []
-        a_machine: list[int] = []
-        a_rack: list[int] = []
-
-        a_weight: list[int] = []
-
-        def arc(s: int, d: int, c: int, k: ArcKind,
-                ti: int = -1, mi: int = -1, ri: int = -1, wt: int = 0) -> None:
-            src.append(s)
-            dst.append(d)
-            cap.append(c)
-            kind.append(int(k))
-            a_task.append(ti)
-            a_machine.append(mi)
-            a_rack.append(ri)
-            a_weight.append(wt)
-
-        job_task_count = np.zeros(J, dtype=np.int64)
-        for ti, t in enumerate(tasks):
-            job_task_count[job_idx[t.job_id]] += 1
+        # Everything below is vectorized per arc FAMILY (a per-arc
+        # Python append loop costs ~300 ms at the 10k-pod flagship and
+        # runs every scheduling round). Family order:
+        # [task->unsched, task->cluster, prefs..., cluster->machine,
+        #  rack->machine, machine->sink, unsched->sink]; nothing
+        # downstream depends on arc order, only on kind labels.
+        job_of = np.array(
+            [job_idx[t.job_id] for t in tasks], dtype=np.int32
+        )
+        job_task_count = np.bincount(
+            job_of, minlength=J
+        ).astype(np.int64) if T else np.zeros(J, np.int64)
 
         # Slots already consumed by RUNNING tasks: the reference tracks
         # running tasks against --max_tasks_per_pu inside Firmament; we
         # discount machine capacity here so re-offered slots are real.
         used_slots = np.zeros(M, dtype=np.int64)
-        for t in cluster.tasks:
-            if t.phase == TaskPhase.RUNNING and t.machine in midx:
-                used_slots[midx[t.machine]] += 1
+        running = [
+            midx[t.machine] for t in cluster.tasks
+            if t.phase == TaskPhase.RUNNING and t.machine in midx
+        ]
+        if running:
+            np.add.at(used_slots, running, 1)
 
-        # task arcs
-        for ti, t in enumerate(tasks):
-            tnode = task_base + ti
-            ji = job_idx[t.job_id]
-            arc(tnode, unsched_base + ji, 1, ArcKind.TASK_TO_UNSCHED, ti=ti)
-            arc(tnode, CLUSTER, 1, ArcKind.TASK_TO_CLUSTER, ti=ti)
-            if self.pref_arcs:
-                for name, weight in t.data_prefs.items():
-                    if name in midx:
-                        arc(tnode, machine_base + midx[name], 1,
-                            ArcKind.TASK_TO_MACHINE, ti=ti, mi=midx[name],
-                            wt=int(weight))
-                    elif name in rack_idx:
-                        arc(tnode, rack_base + rack_idx[name], 1,
-                            ArcKind.TASK_TO_RACK, ti=ti, ri=rack_idx[name],
-                            wt=int(weight))
+        t_ids = np.arange(T, dtype=np.int32)
+        t_nodes = task_base + t_ids
 
-        # aggregator -> machine arcs
-        for mi, m in enumerate(machines):
-            slots = max(int(m.max_tasks) - int(used_slots[mi]), 0)
-            mnode = machine_base + mi
-            arc(CLUSTER, mnode, slots, ArcKind.CLUSTER_TO_MACHINE, mi=mi)
-            if m.rack and m.rack in rack_idx:
-                arc(rack_base + rack_idx[m.rack], mnode, slots,
-                    ArcKind.RACK_TO_MACHINE, mi=mi, ri=rack_idx[m.rack])
-            arc(mnode, SINK, slots, ArcKind.MACHINE_TO_SINK, mi=mi)
+        # ragged preference triples, one pass over the (small) dicts
+        if self.pref_arcs:
+            trip = [
+                (ti, midx.get(name, -1), rack_idx.get(name, -1),
+                 int(weight))
+                for ti, t in enumerate(tasks)
+                for name, weight in t.data_prefs.items()
+                if name in midx or name in rack_idx
+            ]
+        else:
+            trip = []
+        p_t = np.array([x[0] for x in trip], dtype=np.int32)
+        p_m = np.array([x[1] for x in trip], dtype=np.int32)
+        p_r = np.array([x[2] for x in trip], dtype=np.int32)
+        p_w = np.array([x[3] for x in trip], dtype=np.int32)
+        is_mp = p_m >= 0
 
-        # unscheduled aggregators drain to sink
-        for ji in range(J):
-            arc(unsched_base + ji, SINK, int(job_task_count[ji]),
-                ArcKind.UNSCHED_TO_SINK)
+        m_ids = np.arange(M, dtype=np.int32)
+        m_nodes = machine_base + m_ids
+        slots = np.maximum(
+            np.array([int(m.max_tasks) for m in machines], np.int64)
+            - used_slots, 0,
+        ).astype(np.int32)
+        m_rack = np.array(
+            [rack_idx.get(m.rack, -1) if m.rack else -1 for m in machines],
+            dtype=np.int32,
+        )
+        has_rack = m_rack >= 0
+
+        def fam(n, s, d, c, k, ti=None, mi=None, ri=None, wt=None):
+            neg1 = np.full(n, -1, np.int32)
+            return (
+                np.broadcast_to(np.asarray(s, np.int32), (n,)),
+                np.broadcast_to(np.asarray(d, np.int32), (n,)),
+                np.broadcast_to(np.asarray(c, np.int32), (n,)),
+                np.full(n, int(k), np.int8),
+                neg1 if ti is None else np.asarray(ti, np.int32),
+                neg1 if mi is None else np.asarray(mi, np.int32),
+                neg1 if ri is None else np.asarray(ri, np.int32),
+                np.zeros(n, np.int32) if wt is None
+                else np.asarray(wt, np.int32),
+            )
+
+        families = [
+            fam(T, t_nodes, unsched_base + job_of, 1,
+                ArcKind.TASK_TO_UNSCHED, ti=t_ids),
+            fam(T, t_nodes, CLUSTER, 1, ArcKind.TASK_TO_CLUSTER,
+                ti=t_ids),
+            fam(int(is_mp.sum()), task_base + p_t[is_mp],
+                machine_base + p_m[is_mp], 1, ArcKind.TASK_TO_MACHINE,
+                ti=p_t[is_mp], mi=p_m[is_mp], wt=p_w[is_mp]),
+            fam(int((~is_mp).sum()), task_base + p_t[~is_mp],
+                rack_base + p_r[~is_mp], 1, ArcKind.TASK_TO_RACK,
+                ti=p_t[~is_mp], ri=p_r[~is_mp], wt=p_w[~is_mp]),
+            fam(M, CLUSTER, m_nodes, slots, ArcKind.CLUSTER_TO_MACHINE,
+                mi=m_ids),
+            fam(int(has_rack.sum()), rack_base + m_rack[has_rack],
+                m_nodes[has_rack], slots[has_rack],
+                ArcKind.RACK_TO_MACHINE, mi=m_ids[has_rack],
+                ri=m_rack[has_rack]),
+            fam(M, m_nodes, SINK, slots, ArcKind.MACHINE_TO_SINK,
+                mi=m_ids),
+            fam(J, unsched_base + np.arange(J, dtype=np.int32), SINK,
+                job_task_count.astype(np.int32),
+                ArcKind.UNSCHED_TO_SINK),
+        ]
+        src, dst, cap, kind, a_task, a_machine, a_rack, a_weight = (
+            np.concatenate(cols) for cols in zip(*families)
+        )
 
         supply = np.zeros(n_nodes, dtype=np.int64)
         supply[task_base:] = 1
@@ -192,19 +225,17 @@ class FlowGraphBuilder:
 
         n_arcs = len(src)
         net = FlowNetwork.from_arrays(
-            np.array(src, dtype=np.int32),
-            np.array(dst, dtype=np.int32),
-            np.array(cap, dtype=np.int32),
+            src, dst, cap,
             np.zeros(n_arcs, dtype=np.int32),  # costs come from the model
             supply,
         )
         meta = GraphMeta(
             node_role=node_role,
-            arc_kind=np.array(kind, dtype=np.int8),
-            arc_task=np.array(a_task, dtype=np.int32),
-            arc_machine=np.array(a_machine, dtype=np.int32),
-            arc_rack=np.array(a_rack, dtype=np.int32),
-            arc_weight=np.array(a_weight, dtype=np.int32),
+            arc_kind=kind,
+            arc_task=a_task,
+            arc_machine=a_machine,
+            arc_rack=a_rack,
+            arc_weight=a_weight,
             task_wait=np.array([t.wait_rounds for t in tasks],
                                dtype=np.int32),
             task_node=np.arange(task_base, task_base + T, dtype=np.int32),

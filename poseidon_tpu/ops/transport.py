@@ -130,113 +130,117 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     def arcs_of(k: ArcKind) -> np.ndarray:
         return np.where(kind == int(k))[0]
 
+    def unique_per_key(arcs, keys, n, label) -> np.ndarray:
+        """Scatter arc ids by key; every key exactly once (vectorized —
+        the per-arc Python loops here ran every scheduling round and
+        cost more than the solve at 12k machines)."""
+        keys = np.asarray(keys)
+        if (keys < 0).any():
+            raise NotSchedulingShaped(f"unlabeled {label} arc")
+        counts = np.bincount(keys, minlength=n)
+        if (counts > 1).any():
+            raise NotSchedulingShaped(f"duplicate {label} arc")
+        if (counts == 0).any():
+            raise NotSchedulingShaped(f"missing {label} arc")
+        out = np.full(n, -1, np.int32)
+        out[keys] = arcs
+        return out
+
     # machine -> sink: the binding capacity
     m2s = arcs_of(ArcKind.MACHINE_TO_SINK)
-    arc_m2s = np.full(M, -1, np.int32)
-    g = np.full(M, INF, np.int64)
-    slots = np.zeros(M, np.int32)
-    for a in m2s:
-        m = meta.arc_machine[a]
-        if m < 0 or arc_m2s[m] >= 0:
-            raise NotSchedulingShaped("duplicate or unlabeled machine->sink")
-        arc_m2s[m] = a
-        g[m] = cost[a]
-        slots[m] = cap[a]
-    if M and (arc_m2s < 0).any():
-        raise NotSchedulingShaped("machine without machine->sink arc")
+    arc_m2s = unique_per_key(m2s, meta.arc_machine[m2s], M, "machine->sink")
+    g = cost[arc_m2s]
+    slots = cap[arc_m2s].astype(np.int32)
 
     c2m = arcs_of(ArcKind.CLUSTER_TO_MACHINE)
-    arc_c2m = np.full(M, -1, np.int32)
-    d = np.full(M, INF, np.int64)
-    for a in c2m:
-        m = meta.arc_machine[a]
-        if m < 0 or arc_c2m[m] >= 0:
-            raise NotSchedulingShaped("duplicate or unlabeled cluster->machine")
-        arc_c2m[m] = a
-        d[m] = cost[a] + g[m]
-        if cap[a] != slots[m]:
-            raise NotSchedulingShaped("cluster->machine cap != machine slots")
+    arc_c2m = unique_per_key(
+        c2m, meta.arc_machine[c2m], M, "cluster->machine"
+    )
+    d = cost[arc_c2m] + g
+    if (cap[arc_c2m] != slots).any():
+        raise NotSchedulingShaped("cluster->machine cap != machine slots")
 
+    # rack -> machine is optional per machine
     r2m = arcs_of(ArcKind.RACK_TO_MACHINE)
     arc_r2m = np.full(M, -1, np.int32)
     ra = np.full(M, INF, np.int64)
     rack_of = np.full(M, -1, np.int32)
-    for a in r2m:
-        m = meta.arc_machine[a]
-        if m < 0 or arc_r2m[m] >= 0:
-            raise NotSchedulingShaped("duplicate or unlabeled rack->machine")
-        arc_r2m[m] = a
-        ra[m] = cost[a] + g[m]
-        rack_of[m] = meta.arc_rack[a]
-        if cap[a] != slots[m]:
+    if len(r2m):
+        rm = meta.arc_machine[r2m]
+        if (rm < 0).any():
+            raise NotSchedulingShaped("unlabeled rack->machine arc")
+        if np.bincount(rm, minlength=M).max(initial=0) > 1:
+            raise NotSchedulingShaped("duplicate rack->machine arc")
+        arc_r2m[rm] = r2m
+        ra[rm] = cost[r2m] + g[rm]
+        rack_of[rm] = meta.arc_rack[r2m]
+        if (cap[r2m] != slots[rm]).any():
             raise NotSchedulingShaped("rack->machine cap != machine slots")
 
     # unsched aggregators: task->unsched + unsched->sink
     u2s = arcs_of(ArcKind.UNSCHED_TO_SINK)
-    node_to_job: dict[int, int] = {}
-    job_sink_cost = np.zeros(len(u2s), np.int64)
-    job_sink_cap = np.zeros(len(u2s), np.int64)
-    unsched_sink_arc = np.zeros(len(u2s), np.int32)
-    for j, a in enumerate(u2s):
-        node_to_job[int(host["src"][a])] = j
-        job_sink_cost[j] = cost[a]
-        job_sink_cap[j] = cap[a]
-        unsched_sink_arc[j] = a
+    J = len(u2s)
+    job_sink_cost = cost[u2s] if J else np.zeros(0, np.int64)
+    job_sink_cap = cap[u2s] if J else np.zeros(0, np.int64)
+    # map aggregator node id -> job index via a dense node lookup
+    node_job = np.full(meta.n_nodes, -1, np.int32)
+    node_job[host["src"][u2s].astype(np.int64)] = np.arange(
+        J, dtype=np.int32
+    )
 
     t2u = arcs_of(ArcKind.TASK_TO_UNSCHED)
-    arc_unsched = np.full(T, -1, np.int32)
-    arc_u2s = np.full(T, -1, np.int32)
-    job_of = np.full(T, -1, np.int32)
-    u = np.full(T, INF, np.int64)
-    tu = np.full(T, INF, np.int64)
-    for a in t2u:
-        t = meta.arc_task[a]
-        node = int(host["dst"][a])
-        if t < 0 or node not in node_to_job:
-            raise NotSchedulingShaped("unsched arc without aggregator drain")
-        if arc_unsched[t] >= 0:
-            raise NotSchedulingShaped("duplicate task->unsched arc")
-        j = node_to_job[node]
-        arc_unsched[t] = a
-        arc_u2s[t] = unsched_sink_arc[j]
-        job_of[t] = j
-        tu[t] = cost[a]
-        u[t] = cost[a] + job_sink_cost[j]
-    if T and (arc_unsched < 0).any():
-        raise NotSchedulingShaped("task without unsched arc")
+    arc_unsched = unique_per_key(
+        t2u, meta.arc_task[t2u], T, "task->unsched"
+    )
+    drain = host["dst"][arc_unsched].astype(np.int64)
+    job_of = node_job[drain]
+    if (job_of < 0).any():
+        raise NotSchedulingShaped("unsched arc without aggregator drain")
+    tu = cost[arc_unsched]
+    u = tu + job_sink_cost[job_of]
+    arc_u2s = u2s[job_of].astype(np.int32)
 
     t2c = arcs_of(ArcKind.TASK_TO_CLUSTER)
-    arc_cluster = np.full(T, -1, np.int32)
-    w = np.full(T, INF, np.int64)
-    for a in t2c:
-        t = meta.arc_task[a]
-        if t < 0 or arc_cluster[t] >= 0:
-            raise NotSchedulingShaped("duplicate or unlabeled task->cluster")
-        arc_cluster[t] = a
-        w[t] = cost[a]
-    if T and (arc_cluster < 0).any():
-        raise NotSchedulingShaped("task without cluster arc")
+    arc_cluster = unique_per_key(
+        t2c, meta.arc_task[t2c], T, "task->cluster"
+    )
+    w = cost[arc_cluster]
 
-    # preference arcs, ragged -> padded [T, P]
-    pref_lists: list[list[tuple[int, int, int, int]]] = [[] for _ in range(T)]
-    for a in arcs_of(ArcKind.TASK_TO_MACHINE):
-        t, m = meta.arc_task[a], meta.arc_machine[a]
-        pref_lists[t].append((int(cost[a] + g[m]), m, -1, int(a)))
-    for a in arcs_of(ArcKind.TASK_TO_RACK):
-        t, r = meta.arc_task[a], meta.arc_rack[a]
-        pref_lists[t].append((int(cost[a]), -1, r, int(a)))
-    P = max((len(p) for p in pref_lists), default=0)
-    P = max(P, 1)
+    # preference arcs, ragged -> padded [T, P] (rank by stable sort)
+    tm = arcs_of(ArcKind.TASK_TO_MACHINE)
+    tr = arcs_of(ArcKind.TASK_TO_RACK)
+    pa = np.concatenate([tm, tr]).astype(np.int32)
+    pt = np.concatenate([meta.arc_task[tm], meta.arc_task[tr]])
+    if len(pa) and (pt < 0).any():
+        raise NotSchedulingShaped("unlabeled preference arc")
+    pm = np.concatenate(
+        [meta.arc_machine[tm], np.full(len(tr), -1, np.int32)]
+    )
+    pr = np.concatenate(
+        [np.full(len(tm), -1, np.int32), meta.arc_rack[tr]]
+    )
+    pc = np.concatenate(
+        [cost[tm] + g[np.maximum(meta.arc_machine[tm], 0)], cost[tr]]
+    ) if len(pa) else np.zeros(0, np.int64)
+    if len(pa):
+        order = np.argsort(pt, kind="stable")
+        pt, pm, pr, pc, pa = pt[order], pm[order], pr[order], pc[order], pa[order]
+        counts = np.bincount(pt, minlength=T)
+        P = max(int(counts.max(initial=0)), 1)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        rank = np.arange(len(pa)) - starts[pt]
+    else:
+        P = 1
+        rank = np.zeros(0, np.int64)
     pref_cost = np.full((T, P), INF, np.int64)
     pref_machine = np.full((T, P), -1, np.int32)
     pref_rack = np.full((T, P), -1, np.int32)
     arc_pref = np.full((T, P), -1, np.int32)
-    for t, plist in enumerate(pref_lists):
-        for k, (c, m, r, a) in enumerate(plist):
-            pref_cost[t, k] = c
-            pref_machine[t, k] = m
-            pref_rack[t, k] = r
-            arc_pref[t, k] = a
+    if len(pa):
+        pref_cost[pt, rank] = pc
+        pref_machine[pt, rank] = pm
+        pref_rack[pt, rank] = pr
+        arc_pref[pt, rank] = pa
 
     labeled = (
         len(t2u) + len(t2c) + len(c2m) + len(r2m) + len(m2s) + len(u2s)

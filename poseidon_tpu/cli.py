@@ -68,6 +68,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--logtostderr", action="store_true")
     p.add_argument("--flagfile", default="",
                    help="gflags-style file of --name=value lines")
+    # reference-compat flags, accepted and ignored so the reference's
+    # own flagfiles load unchanged (deploy/poseidon.cfg): the solver
+    # seam is the in-process TPU kernel (no binary/algorithm choice)
+    # and incremental change batching is subsumed by the warm on-HBM
+    # re-solve (prices/assignments carry over; the graph rebuild is
+    # vectorized and costs ~ms)
+    for compat in (
+        "--scheduler", "--flow_scheduling_solver",
+        "--flow_scheduling_binary", "--flowlessly_algorithm",
+        "--only_read_assignment_changes", "--remove_duplicate_changes",
+        "--merge_changes_to_same_arc",
+        "--purge_changes_before_node_removal",
+    ):
+        # nargs="?": gflags booleans appear both bare
+        # (--only_read_assignment_changes) and as --flag=value
+        p.add_argument(compat, nargs="?", const="true", default=None,
+                       help=argparse.SUPPRESS)
+    p.add_argument("--log_solver_stderr", action="store_true",
+                   help=argparse.SUPPRESS)
     # operational extras
     p.add_argument("--max_rounds", type=int, default=0,
                    help="exit after N scheduling rounds (0 = forever)")

@@ -30,6 +30,7 @@ Deliberate fixes over the reference's semantics:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import time
@@ -102,7 +103,11 @@ class SchedulerBridge:
         self.pod_to_machine: dict[str, str] = {}
         self.round_num = 0
         self.warm_state = None
-        self.decision_log: list[tuple[int, str, str]] = []
+        # bounded: a daemon running forever must not grow without bound
+        # (full history goes to the trace stream when a sink is set)
+        self.decision_log: collections.deque[tuple[int, str, str]] = (
+            collections.deque(maxlen=100_000)
+        )
         self._evictions_this_round = 0
 
     # ---- observation (the poll side) -----------------------------------
@@ -271,9 +276,19 @@ class SchedulerBridge:
         stats.cost = outcome.cost
 
         t0 = time.perf_counter()
-        placements = extract_placements(
-            outcome.flows, meta, np.asarray(net.src), np.asarray(net.dst)
-        )
+        if outcome.assignment is not None:
+            # the auction hands back the assignment directly; flow
+            # decomposition is only needed for oracle-path solves
+            names = meta.machine_names
+            placements = {
+                uid: (names[m] if m >= 0 else None)
+                for uid, m in zip(meta.task_uids, outcome.assignment)
+            }
+        else:
+            placements = extract_placements(
+                outcome.flows, meta,
+                np.asarray(net.src), np.asarray(net.dst),
+            )
         stats.decompose_ms = (time.perf_counter() - t0) * 1000
 
         bindings: dict[str, str] = {}

@@ -85,8 +85,8 @@ def build_parser() -> argparse.ArgumentParser:
         # (--only_read_assignment_changes) and as --flag=value
         p.add_argument(compat, nargs="?", const="true", default=None,
                        help=argparse.SUPPRESS)
-    p.add_argument("--log_solver_stderr", action="store_true",
-                   help=argparse.SUPPRESS)
+    p.add_argument("--log_solver_stderr", nargs="?", const="true",
+                   default=None, help=argparse.SUPPRESS)
     # operational extras
     p.add_argument("--max_rounds", type=int, default=0,
                    help="exit after N scheduling rounds (0 = forever)")

@@ -60,6 +60,11 @@ class SolveOutcome:
     solve_ms: float          # wall time of the successful solve
     state: DenseState | None  # warm handle for the next round (TPU path)
     instance: TransportInstance | None
+    # per-task machine index (or -1) when the backend produced an
+    # assignment directly — lets callers skip flow decomposition
+    # entirely (the general path-peeling costs ~130 ms at the flagship
+    # scale; the auction already knows every task's machine)
+    assignment: np.ndarray | None = None
 
 
 def solve_scheduling(
@@ -115,6 +120,7 @@ def solve_scheduling(
             solve_ms=(time.perf_counter() - t0) * 1000,
             state=state,
             instance=inst,
+            assignment=res.assignment,
         )
     if not oracle_fallback:
         raise RuntimeError(

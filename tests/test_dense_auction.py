@@ -8,6 +8,8 @@ earlier designs (all-tied markets, over-subscribed capacity, empty
 clusters).
 """
 
+from collections import Counter
+
 import numpy as np
 import pytest
 
@@ -232,3 +234,32 @@ class TestFrontDoor:
         out = solve_scheduling(net, meta)
         assert out.backend.startswith("oracle:")
         assert out.cost == 12
+
+
+class TestPlacementPaths:
+    def test_direct_assignment_matches_flow_decomposition(self):
+        """The bridge's fast path (assignment -> placements) must agree
+        with the general flow-peeling path on the same solve."""
+        rng = np.random.default_rng(31)
+        cluster = random_cluster(rng, 14, 90)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "quincy", cluster)
+        out = solve_scheduling(net, meta)
+        assert out.assignment is not None
+        direct = {
+            uid: (meta.machine_names[m] if m >= 0 else None)
+            for uid, m in zip(meta.task_uids, out.assignment)
+        }
+        peeled = extract_placements(
+            out.flows, meta, np.asarray(net.src), np.asarray(net.dst)
+        )
+        # tasks routed through aggregators lose identity in the flow, so
+        # peeling may pair them differently — but the two placements
+        # must be EQUIVALENT: same unscheduled set and same per-machine
+        # occupancy (hence the same exact cost)
+        assert {u for u, m in direct.items() if m is None} == {
+            u for u, m in peeled.items() if m is None
+        }
+        assert Counter(
+            m for m in direct.values() if m
+        ) == Counter(m for m in peeled.values() if m)

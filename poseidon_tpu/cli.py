@@ -17,7 +17,13 @@ Differences from the reference loop, on purpose:
   scheduler_integration.cc / scheduler_bridge.cc:165-168 strands pods
   that arrived during a failed tick);
 - successful bindings are confirmed into the bridge immediately so the
-  next round's capacity math does not depend on poll latency.
+  next round's capacity math does not depend on poll latency;
+- the tick stays deliberately serial (SURVEY §7 suggests overlapping
+  solve with the next poll to fix the reference's blocking loop): with
+  the TPU solve at ~10-100 ms against a 10 s polling period, pipelining
+  rounds would buy nothing and would let a solve run against stale
+  observations. The solve itself is already asynchronous on device
+  until its results are read.
 
 Run: ``python -m poseidon_tpu.cli --k8s_apiserver_port=8080
 --flow_scheduling_cost_model=quincy --max_rounds=0``
@@ -183,7 +189,14 @@ def run_loop(args: argparse.Namespace) -> int:
             bridge.observe_pods(pods)
             if not incremental:
                 bridge.warm_state = None
-            result = bridge.run_scheduler()
+            try:
+                result = bridge.run_scheduler()
+            except Exception:
+                # a failed round (oracle timeout, device fault) must not
+                # kill the daemon; state is rebuilt from the next poll
+                log.exception("scheduling round failed; skipping tick")
+                time.sleep(args.polling_frequency / 1e6)
+                continue
             for uid, machine in result.bindings.items():
                 task = bridge.tasks.get(uid)
                 ns = task.namespace if task else "default"

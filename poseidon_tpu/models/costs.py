@@ -33,6 +33,24 @@ from poseidon_tpu.graph.network import FlowNetwork, pad_bucket
 COST_CAP = 10_000
 _SCALE = 10
 
+# Flagship-domain ceiling. The dense auction requires 2*cmax*(T+1) <
+# MAX_SCALED_COST (ops/dense_auction.py overflow analysis), which at the
+# flagship envelope T = 10k admits per-arc costs up to ~6.7k. Every
+# structurally-unbounded input a registry model prices must therefore be
+# clamped under DOMAIN_SAFE_COST, or rounds at flagship scale silently
+# demote to the CPU oracle (round-3 advisor finding):
+# - wait-rounds aging grows every starved round -> capped at WAIT_CAP
+#   (beyond it a parked task already exerts maximum pressure); worst
+#   cases quincy 5*_SCALE*(WAIT_CAP+1) = 3.05k, coco COST_CAP//4 +
+#   5*_SCALE*WAIT_CAP = 5.5k;
+# - quincy's task_input (summed locality weights, data-dependent) ->
+#   clamped so TASK_TO_CLUSTER = total + _SCALE stays at 6k.
+# Genuinely pathological data (e.g. octopus with >600 running tasks on
+# one machine) can still exceed the ceiling; those rounds fall back to
+# the oracle loudly, which is the intended envelope behavior.
+DOMAIN_SAFE_COST = 6_000
+WAIT_CAP = 60
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +81,22 @@ class CostInputs:
 def build_cost_inputs(
     net: FlowNetwork,
     meta: GraphMeta,
+    **kwargs,
+) -> CostInputs:
+    """Assemble padded pricing inputs and upload them to device.
+
+    See ``build_cost_inputs_host`` for the fields; this variant is the
+    convenience path for tests and one-shot solves (each ``jnp.asarray``
+    is its own transfer). The production round batches the host variant
+    into one ``jax.device_put`` (ops/resident.py).
+    """
+    host = build_cost_inputs_host(net.num_arc_slots, meta, **kwargs)
+    return jax.tree_util.tree_map(jnp.asarray, host)
+
+
+def build_cost_inputs_host(
+    arc_slots: int,
+    meta: GraphMeta,
     *,
     task_cpu_milli: np.ndarray | None = None,
     task_mem_kb: np.ndarray | None = None,
@@ -71,14 +105,15 @@ def build_cost_inputs(
     machine_mem_free: np.ndarray | None = None,
     machine_used_slots: np.ndarray | None = None,
 ) -> CostInputs:
-    """Assemble padded pricing inputs from builder metadata + KB aggregates.
+    """Assemble padded pricing inputs from builder metadata + KB aggregates,
+    as HOST numpy arrays (no device traffic).
 
     The sample-derived arrays (``machine_load`` etc.) come from
     ``KnowledgeBase`` aggregates; they default to an idle, unsampled
     cluster. Shapes: per-task arrays length n_tasks, per-machine length
     n_machines (padded here).
     """
-    E = net.num_arc_slots
+    E = arc_slots
     T = len(meta.task_uids)
     M = len(meta.machine_names)
     Tp, Mp = pad_bucket(max(T, 1)), pad_bucket(max(M, 1))
@@ -100,25 +135,24 @@ def build_cost_inputs(
     tin = np.zeros(Tp, np.int64)
     np.add.at(tin, np.maximum(meta.arc_task, 0),
               np.where(meta.arc_task >= 0, meta.arc_weight, 0))
+    tin = np.minimum(tin, DOMAIN_SAFE_COST - _SCALE)
     return CostInputs(
-        kind=jnp.asarray(pad_arc(meta.arc_kind.astype(np.int32), -1)),
-        task=jnp.asarray(pad_arc(np.maximum(meta.arc_task, 0), 0)),
-        machine=jnp.asarray(pad_arc(np.maximum(meta.arc_machine, 0), 0)),
-        weight=jnp.asarray(pad_arc(meta.arc_weight, 0)),
-        valid=jnp.asarray(np.arange(E) < meta.n_arcs),
-        task_wait=jnp.asarray(padv(meta.task_wait, Tp, np.int32)),
-        task_input=jnp.asarray(np.minimum(tin, COST_CAP).astype(np.int32)),
-        task_cpu=jnp.asarray(padv(task_cpu_milli, Tp, np.int32)),
-        task_mem_kb=jnp.asarray(padv(task_mem_kb, Tp, np.int32)),
-        task_usage=jnp.asarray(padv(task_usage, Tp, np.float32)),
-        machine_load=jnp.asarray(padv(machine_load, Mp, np.float32)),
-        machine_mem_free=jnp.asarray(
+        kind=pad_arc(meta.arc_kind.astype(np.int32), -1),
+        task=pad_arc(np.maximum(meta.arc_task, 0), 0),
+        machine=pad_arc(np.maximum(meta.arc_machine, 0), 0),
+        weight=pad_arc(meta.arc_weight, 0),
+        valid=np.arange(E) < meta.n_arcs,
+        task_wait=padv(meta.task_wait, Tp, np.int32),
+        task_input=tin.astype(np.int32),
+        task_cpu=padv(task_cpu_milli, Tp, np.int32),
+        task_mem_kb=padv(task_mem_kb, Tp, np.int32),
+        task_usage=padv(task_usage, Tp, np.float32),
+        machine_load=padv(machine_load, Mp, np.float32),
+        machine_mem_free=(
             padv(machine_mem_free, Mp, np.float32)
             if machine_mem_free is not None else np.ones(Mp, np.float32)
         ),
-        machine_used_slots=jnp.asarray(
-            padv(machine_used_slots, Mp, np.int32)
-        ),
+        machine_used_slots=padv(machine_used_slots, Mp, np.int32),
     )
 
 
@@ -177,7 +211,7 @@ def quincy_cost(inputs: CostInputs) -> jax.Array:
             | _kind(inputs, ArcKind.TASK_TO_RACK))
     c = jnp.where(pref, remote, c)
     c = jnp.where(_kind(inputs, ArcKind.TASK_TO_CLUSTER), total + _SCALE, c)
-    wait = inputs.task_wait[inputs.task]
+    wait = jnp.minimum(inputs.task_wait[inputs.task], WAIT_CAP)
     c = jnp.where(_kind(inputs, ArcKind.TASK_TO_UNSCHED),
                   5 * _SCALE * (wait + 1), c)
     # crossing a rack boundary to reach the machine costs a hop
@@ -247,7 +281,7 @@ def coco_cost(inputs: CostInputs) -> jax.Array:
                | _kind(inputs, ArcKind.RACK_TO_MACHINE))
     c = jnp.where(placing, score, c)
     c = jnp.where(_kind(inputs, ArcKind.TASK_TO_CLUSTER), 3 * _SCALE, c)
-    wait = inputs.task_wait[inputs.task]
+    wait = jnp.minimum(inputs.task_wait[inputs.task], WAIT_CAP)
     c = jnp.where(_kind(inputs, ArcKind.TASK_TO_UNSCHED),
                   COST_CAP // 4 + 5 * _SCALE * wait, c)
     return _finish(inputs, c)

@@ -161,10 +161,12 @@ def solve_what_if(
 ) -> BatchResult:
     """Solve ``n_variants`` perturbed copies of ``inst`` in one program."""
     dev = build_dense_instance(inst)
-    c, u, w, dg, cmax = perturb_costs(
-        dev, n_variants, seed, magnitude_pct=magnitude_pct
-    )
     with jax.enable_x64(True):
+        # perturb_costs does its jitter math in int64; outside this
+        # context the casts silently truncate to int32 (round-3 advisor)
+        c, u, w, dg, cmax = perturb_costs(
+            dev, n_variants, seed, magnitude_pct=magnitude_pct
+        )
         cost, conv, asg, rounds = _solve_batch(
             c, u, w, dg, cmax, dev.s, dev.task_valid, dev.scale,
             smax=dev.smax, alpha=alpha, max_rounds=max_rounds,

@@ -71,9 +71,20 @@ from poseidon_tpu.ops.transport import (
 )
 
 I32 = jnp.int32
-INF = np.int32(2**28)       # saturation cap; all finite values stay below
+INF = np.int32(2**29)       # saturation cap; all finite values stay below
 _NPINF = np.int64(2**48)    # host INF used by TransportInstance
-MAX_SCALED_COST = 2**26     # guard: scaled costs must stay below this
+MAX_SCALED_COST = 2**27     # guard: scaled costs must stay below this
+
+# Overflow analysis pinning INF = 2^29 and MAX_SCALED_COST = 2^27:
+# every int32 sum in the kernel has at most two INF-saturated terms
+# (w+d, pc+ra, c+p, b1+eps), so the worst partial is 2*INF = 2^30 < 2^31.
+# Finite prices stay distinguishable from INF because a committed level
+# is at most b2 + eps <= cmax_scaled/2 + eps0 <= 1.5*MAX_SCALED_COST =
+# 1.5*2^27 < INF (4x margin). Wider sums (beta, the violator value, the
+# dual) are computed in int64 and clipped back. The guard itself bounds
+# 2*cmax*(T+1): at the flagship T = 10k that admits per-arc costs up to
+# ~6.7k — cost models whose terms can grow without bound (wait-rounds
+# aging) must cap them below that (models/costs.py WAIT_CAP).
 
 
 class CostDomainTooLarge(ValueError):

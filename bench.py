@@ -135,6 +135,53 @@ def bench_config(
     res_w, _ = solve_transport_dense(inst, warm=st)
     row["warm_cost_match"] = bool(res_w.cost == res.cost)
 
+    # honest warm number (round-3 verdict: the identity re-solve above is
+    # a best case no production round sees): every rep churns ~1% of
+    # tasks with a +-5% re-pricing delta (the arrival/retirement/aging
+    # reshape of their cost rows) and re-solves WARM from the previous
+    # rep's state. Deltas jitter the UNSCALED cost and rescale, so each
+    # churned instance stays exactly solvable and every rep's
+    # certificate still proves optimality.
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from poseidon_tpu.ops.dense_auction import INF as _INF
+
+    Tp = dev.c.shape[0]
+
+    @jax.jit
+    def _churn(c, u, scale, key):
+        import jax.random as jr
+
+        k1, k2 = jr.split(key)
+        tmask = jr.bernoulli(k1, 0.01, (Tp,))
+        f = jr.randint(k2, (Tp,), 95, 106)
+        cu = jnp.where(
+            tmask[:, None] & (c < _INF),
+            (c // scale * f[:, None] // 100) * scale,
+            c,
+        )
+        uu = jnp.where(tmask, (u // scale * f // 100) * scale, u)
+        return cu, uu
+
+    keys = jax.random.split(jax.random.PRNGKey(123), solve_reps + 1)
+    c1, u1 = _churn(dev.c, dev.u, dev.scale, keys[-1])
+    stc = solve_dense(dc.replace(dev, c=c1, u=u1), warm=st)
+    jax.block_until_ready(stc.asg)  # compile warm-churn path off-clock
+    stc = st
+    conv_all = jnp.bool_(True)
+    ta = time.perf_counter()
+    for r in range(solve_reps):
+        c1, u1 = _churn(dev.c, dev.u, dev.scale, keys[r])
+        stc = solve_dense(dc.replace(dev, c=c1, u=u1), warm=stc)
+        conv_all = conv_all & stc.converged
+    jax.block_until_ready(stc.asg)
+    row["solve_warm_churn_ms"] = round(
+        (time.perf_counter() - ta) * 1000 / solve_reps, 3
+    )
+    row["warm_churn_all_converged"] = bool(jax.device_get(conv_all))
+
     t5 = time.perf_counter()
     flows = flows_from_assignment(inst, res, int(net.n_arcs))
     placements = extract_placements(
@@ -160,8 +207,12 @@ def bench_config(
         row["speedup_warm_vs_oracle"] = round(
             row["oracle_ms"] / row["solve_warm_ms"], 2
         )
+    if row.get("solve_warm_churn_ms", 0) > 0:
+        row["speedup_warm_churn_vs_oracle"] = round(
+            row["oracle_ms"] / row["solve_warm_churn_ms"], 2
+        )
         row["pods_per_sec"] = round(
-            inst.n_tasks / (row["solve_warm_ms"] / 1000), 1
+            inst.n_tasks / (row["solve_warm_churn_ms"] / 1000), 1
         )
 
     if what_if:
@@ -178,6 +229,14 @@ def bench_config(
         row["what_if_total_ms"] = round(dt * 1000, 3)
         row["what_if_per_instance_ms"] = round(dt * 1000 / what_if, 3)
         row["what_if_all_converged"] = bool(all(batch.converged))
+        # serial-CPU comparison: the reference's architecture would run
+        # its solver binary once per variant; the unperturbed instance's
+        # oracle time is the per-variant proxy (+-10% jitter does not
+        # change the CPU solve's complexity)
+        if row["what_if_per_instance_ms"] > 0:
+            row["what_if_speedup_vs_serial_oracle"] = round(
+                row["oracle_ms"] / row["what_if_per_instance_ms"], 2
+            )
     return row
 
 
@@ -306,7 +365,10 @@ def main() -> int:
         1: ("trivial_10n_100p", synth.config1_trivial_small, "trivial", 0),
         2: ("quincy_1k_10k", synth.config2_quincy_flagship, "quincy", 0),
         3: ("coco_1k_8k", synth.config3_coco, "coco", 0),
-        5: ("whatif_x64", synth.config1_trivial_small, "quincy", 64),
+        # round 3 benched 64 toy variants where serial CPU wins; the
+        # capability exists at scale: 8 flagship-class variants in one
+        # lockstep program (VERDICT round 3, Next #5)
+        5: ("whatif_x8_1k4k", synth.config5_whatif, "quincy", 8),
     }
 
     rows = []
@@ -350,15 +412,21 @@ def main() -> int:
         None,
     )
     if flagship is not None:
+        # headline = the churned-warm p50: warm re-solve under a ~1%
+        # per-round re-pricing delta, the number a production round
+        # actually experiences (round-3 verdict: the identity warm
+        # re-solve it used to report is a best case no round sees)
+        value = flagship.get(
+            "solve_warm_churn_ms", flagship["solve_warm_ms"]
+        )
         headline = {
-            "metric": "quincy_1k10k_warm_solve_p50",
-            "value": flagship["solve_warm_ms"],
+            "metric": "quincy_1k10k_warm_churn_solve_p50",
+            "value": value,
             "unit": "ms",
-            "vs_baseline": round(
-                flagship["oracle_ms"] / flagship["solve_warm_ms"], 2
-            ),
+            "vs_baseline": round(flagship["oracle_ms"] / value, 2),
             "exact": flagship["exact"],
-            "converged": flagship["converged"],
+            "converged": flagship["converged"]
+            and flagship.get("warm_churn_all_converged", True),
             "device": str(backend),
             "configs": rows,
         }

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Install poseidon-tpu onto this host (the port of the reference's
+# deploy/deploy_locally.sh, which sudo-copied the poseidon binary +
+# libcpprest + libfirmament + cs2.exe into /usr). Here there are no
+# shared libraries or solver binaries to stage: one pip install carries
+# the whole framework, and the C++ oracle compiles in-tree.
+set -euo pipefail
+DIR=$( cd "$( dirname "${BASH_SOURCE[0]}" )" && pwd )
+
+make -C "${DIR}/../poseidon_tpu/oracle"
+# --editable keeps the oracle binary the package just built in place
+pip install -e "${DIR}/.."[tpu]
+
+echo "installed: $(command -v poseidon-tpu)"
+echo "run:       poseidon-tpu --flagfile=${DIR}/poseidon-tpu.cfg"

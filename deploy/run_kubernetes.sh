@@ -1,0 +1,37 @@
+#!/bin/bash
+# Zero-to-scheduling against a real (or kind) Kubernetes cluster — the
+# modern port of the reference's deploy/run_kubernetes.sh +
+# build_kubernetes.sh pair, which built k8s v1.5 from source and
+# kube-up'd an ubuntu provider cluster. A 2020s cluster needs neither:
+# any conformant apiserver works; `kind` gives a disposable local one.
+#
+# Usage:
+#   ./run_kubernetes.sh            # expects a reachable cluster (kubectl)
+#   CREATE_KIND=1 ./run_kubernetes.sh   # create a local kind cluster first
+#
+# The daemon replaces kube-scheduler for the pods it sees (the
+# reference's README.md:24-27 stance). For a side-by-side trial, give
+# your workloads `schedulerName: poseidon-tpu` and leave kube-scheduler
+# running — pods with a foreign schedulerName are ignored by it.
+set -euo pipefail
+DIR=$( cd "$( dirname "${BASH_SOURCE[0]}" )" && pwd )
+
+if [[ "${CREATE_KIND:-0}" == "1" ]]; then
+  command -v kind >/dev/null || {
+    echo "kind not installed (https://kind.sigs.k8s.io)"; exit 1; }
+  kind create cluster --name poseidon-tpu --wait 120s
+fi
+
+command -v kubectl >/dev/null || { echo "kubectl not found"; exit 1; }
+kubectl version >/dev/null || { echo "no reachable cluster"; exit 1; }
+
+# The daemon speaks plain HTTP to the core v1 API (the reference's
+# transport, k8s_api_client.cc:55). `kubectl proxy` terminates auth/TLS
+# and exposes exactly that surface on localhost.
+kubectl proxy --port=8001 &
+PROXY_PID=$!
+trap 'kill ${PROXY_PID}' EXIT
+sleep 1
+
+K8S_APISERVER_HOST=localhost K8S_APISERVER_PORT=8001 \
+  exec "${DIR}/run.sh" "$@"

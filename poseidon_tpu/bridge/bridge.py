@@ -39,14 +39,12 @@ import numpy as np
 
 from poseidon_tpu.cluster import ClusterState, Machine, Task, TaskPhase
 from poseidon_tpu.graph.builder import FlowGraphBuilder
-from poseidon_tpu.graph.decompose import extract_placements
-from poseidon_tpu.models import build_cost_inputs, get_cost_model
 from poseidon_tpu.models.knowledge import (
     KnowledgeBase,
     MachineSample,
     TaskSample,
 )
-from poseidon_tpu.solver import solve_scheduling
+from poseidon_tpu.ops.resident import ResidentSolver
 from poseidon_tpu.trace import TraceGenerator
 
 log = logging.getLogger(__name__)
@@ -94,7 +92,6 @@ class SchedulerBridge:
         solver_timeout_s: float = 1000.0,
     ):
         self.cost_model = cost_model
-        self.solver_timeout_s = solver_timeout_s
         self.max_tasks_per_machine = max_tasks_per_machine
         self.trace = trace or TraceGenerator()
         self.knowledge = KnowledgeBase(queue_size=sample_queue_size)
@@ -102,7 +99,9 @@ class SchedulerBridge:
         self.tasks: dict[str, Task] = {}
         self.pod_to_machine: dict[str, str] = {}
         self.round_num = 0
-        self.warm_state = None
+        # device-resident solve chain; its warm DenseState lives on HBM
+        # across rounds (the reference's --run_incremental_scheduler seam)
+        self.solver = ResidentSolver(oracle_timeout_s=solver_timeout_s)
         # bounded: a daemon running forever must not grow without bound
         # (full history goes to the trace stream when a sink is set)
         self.decision_log: collections.deque[tuple[int, str, str]] = (
@@ -139,6 +138,7 @@ class SchedulerBridge:
         for name in gone:
             log.warning("node %s removed; evicting its tasks", name)
             del self.machines[name]
+            self.knowledge.retire_machine(name)
             for uid, task in list(self.tasks.items()):
                 if task.machine == name:
                     self.tasks[uid] = dataclasses.replace(
@@ -177,6 +177,23 @@ class SchedulerBridge:
                         pod, wait_rounds=known.wait_rounds
                     )
             elif pod.phase == TaskPhase.RUNNING:
+                if pod.machine and pod.machine not in self.machines:
+                    # The apiserver still reports a binding to a node we
+                    # no longer know (removed in observe_nodes). Adopting
+                    # it would silently undo the eviction and park the
+                    # pod on a ghost machine forever; keep it Pending
+                    # (aging preserved) so the next round re-places it.
+                    log.warning(
+                        "pod %s bound to unknown node %s; keeping it "
+                        "Pending for re-placement", pod.uid, pod.machine,
+                    )
+                    wait = known.wait_rounds if known is not None else 0
+                    self.tasks[pod.uid] = dataclasses.replace(
+                        pod, phase=TaskPhase.PENDING, machine="",
+                        wait_rounds=wait,
+                    )
+                    self.pod_to_machine.pop(pod.uid, None)
+                    continue
                 if known is None or known.machine != pod.machine:
                     # restart reconcile: adopt the apiserver's binding
                     # instead of the reference's CHECK-crash
@@ -204,10 +221,12 @@ class SchedulerBridge:
                                     detail={"phase": str(pod.phase.value)})
                     self.tasks.pop(pod.uid, None)
                     self.pod_to_machine.pop(pod.uid, None)
+                    self.knowledge.retire_task(pod.uid)
         gone = set(self.tasks) - seen
         for uid in gone:
             self.tasks.pop(uid, None)
             self.pod_to_machine.pop(uid, None)
+            self.knowledge.retire_task(uid)
 
     # ---- the scheduling round ------------------------------------------
 
@@ -240,56 +259,49 @@ class SchedulerBridge:
             return RoundResult(bindings={}, stats=stats, unscheduled=[])
 
         t0 = time.perf_counter()
-        net, meta = FlowGraphBuilder().build(cluster)
+        arrays, meta = FlowGraphBuilder().build_arrays(cluster)
         stats.build_ms = (time.perf_counter() - t0) * 1000
 
-        t0 = time.perf_counter()
         machine_names = [m.name for m in cluster.machines]
-        inputs = build_cost_inputs(
-            net,
-            meta,
-            task_cpu_milli=np.array(
-                [int(t.cpu_request * 1000) for t in pending]
-            ),
-            task_mem_kb=np.array(
-                [t.memory_request_kb for t in pending]
-            ),
-            task_usage=self.knowledge.task_cpu_usage(
-                [t.uid for t in pending]
-            ),
-            machine_load=self.knowledge.machine_load(machine_names),
-            machine_mem_free=self.knowledge.machine_mem_free(
-                machine_names
+        outcome = self.solver.run_round(
+            arrays, meta,
+            cost_model=self.cost_model,
+            cost_input_kwargs=dict(
+                task_cpu_milli=np.array(
+                    [int(t.cpu_request * 1000) for t in pending]
+                ),
+                task_mem_kb=np.array(
+                    [t.memory_request_kb for t in pending]
+                ),
+                task_usage=self.knowledge.task_cpu_usage(
+                    [t.uid for t in pending]
+                ),
+                machine_load=self.knowledge.machine_load(machine_names),
+                machine_mem_free=self.knowledge.machine_mem_free(
+                    machine_names
+                ),
             ),
         )
-        net = net.with_costs(get_cost_model(self.cost_model)(inputs))
-        stats.price_ms = (time.perf_counter() - t0) * 1000
-
-        t0 = time.perf_counter()
-        outcome = solve_scheduling(
-            net, meta, warm=self.warm_state,
-            oracle_timeout_s=self.solver_timeout_s,
+        # phase accounting: prep+upload feed the price column, the pure
+        # device compute is the solve column, the result download the
+        # decompose column (transfer vs compute stays distinguishable)
+        stats.price_ms = (
+            outcome.timings.get("prep_ms", 0.0)
+            + outcome.timings.get("upload_ms", 0.0)
         )
-        self.warm_state = outcome.state
-        stats.solve_ms = (time.perf_counter() - t0) * 1000
+        stats.solve_ms = outcome.timings.get("solve_ms", 0.0)
+        stats.decompose_ms = (
+            outcome.timings.get("fetch_ms", 0.0)
+            + outcome.timings.get("oracle_ms", 0.0)
+        )
         stats.backend = outcome.backend
         stats.cost = outcome.cost
 
-        t0 = time.perf_counter()
-        if outcome.assignment is not None:
-            # the auction hands back the assignment directly; flow
-            # decomposition is only needed for oracle-path solves
-            names = meta.machine_names
-            placements = {
-                uid: (names[m] if m >= 0 else None)
-                for uid, m in zip(meta.task_uids, outcome.assignment)
-            }
-        else:
-            placements = extract_placements(
-                outcome.flows, meta,
-                np.asarray(net.src), np.asarray(net.dst),
-            )
-        stats.decompose_ms = (time.perf_counter() - t0) * 1000
+        names = meta.machine_names
+        placements = {
+            uid: (names[m] if m >= 0 else None)
+            for uid, m in zip(meta.task_uids, outcome.assignment)
+        }
 
         bindings: dict[str, str] = {}
         unscheduled: list[str] = []
@@ -324,6 +336,30 @@ class SchedulerBridge:
         return RoundResult(
             bindings=bindings, stats=stats, unscheduled=unscheduled
         )
+
+    @property
+    def solver_timeout_s(self) -> float:
+        """Oracle-fallback budget; delegates to the live solver (the
+        reference's --max_solver_runtime, poseidon.cfg:14-15)."""
+        return self.solver.oracle_timeout_s
+
+    @solver_timeout_s.setter
+    def solver_timeout_s(self, value: float) -> None:
+        self.solver.oracle_timeout_s = value
+
+    @property
+    def warm_state(self):
+        """The solver's on-HBM warm handle (assign None to force cold)."""
+        return self.solver.warm
+
+    @warm_state.setter
+    def warm_state(self, value) -> None:
+        if value is not None:
+            raise ValueError(
+                "warm_state is device-owned; only None (reset) is "
+                "assignable"
+            )
+        self.solver.reset()
 
     def confirm_binding(self, uid: str, machine: str) -> None:
         """Caller reports a successful bindings POST: mark Running so the

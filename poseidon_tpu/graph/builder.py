@@ -86,6 +86,25 @@ class FlowGraphBuilder:
         self.rack_aggs = rack_aggs
 
     def build(self, cluster: ClusterState) -> tuple[FlowNetwork, GraphMeta]:
+        """Build and upload the padded device FlowNetwork + metadata."""
+        arrays, meta = self.build_arrays(cluster)
+        net = FlowNetwork.from_arrays(
+            arrays["src"], arrays["dst"], arrays["cap"],
+            np.zeros(meta.n_arcs, dtype=np.int32),  # costs: the model's job
+            arrays["supply"],
+        )
+        return net, meta
+
+    def build_arrays(
+        self, cluster: ClusterState
+    ) -> tuple[dict[str, np.ndarray], GraphMeta]:
+        """Build the graph as HOST arrays only (no device upload).
+
+        The device-resident round (ops/resident.py) consumes these
+        directly: topology index maps are derived host-side and the only
+        per-round device traffic is one batched upload of pricing inputs
+        — the builder must not force its own src/dst/cap transfer.
+        """
         machines = cluster.machines
         tasks = cluster.pending()
         racks = cluster.racks() if self.rack_aggs else []
@@ -224,11 +243,7 @@ class FlowGraphBuilder:
         supply[SINK] = -T
 
         n_arcs = len(src)
-        net = FlowNetwork.from_arrays(
-            src, dst, cap,
-            np.zeros(n_arcs, dtype=np.int32),  # costs come from the model
-            supply,
-        )
+        arrays = {"src": src, "dst": dst, "cap": cap, "supply": supply}
         meta = GraphMeta(
             node_role=node_role,
             arc_kind=kind,
@@ -249,4 +264,4 @@ class FlowGraphBuilder:
             n_nodes=n_nodes,
             n_arcs=n_arcs,
         )
-        return net, meta
+        return arrays, meta

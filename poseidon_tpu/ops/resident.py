@@ -1,0 +1,470 @@
+"""Device-resident scheduling rounds: one upload in, one download out.
+
+This is the TPU-native replacement for the reference's graph-change
+batching seam (``--only_read_assignment_changes`` /
+``--remove_duplicate_changes`` / ``--merge_changes_to_same_arc``,
+reference deploy/poseidon.cfg:12-19): where the reference amortizes
+re-serializing its flow graph to a solver subprocess by batching graph
+*changes*, here the whole price->densify->solve chain is device-side, so
+there is nothing to re-ship in the first place.
+
+Round-3 postmortem (VERDICT.md): the previous hot path priced arcs ON
+device, downloaded them (`net.to_host()`), rebuilt the dense instance on
+host, and re-uploaded it — 5+ tunnel crossings per round at ~95 ms each,
+which is where trace-replay's 950 ms solve_p50 went. The resident round
+does exactly ONE batched ``jax.device_put`` (pricing inputs + topology
+index maps) and ONE batched ``jax.device_get`` (assignment + certificate),
+with everything between — cost model, densify, eps-ladder auction,
+channel/objective extraction — dispatched device-side with no host sync
+except a ``block_until_ready`` (sub-ms on the tunnel).
+
+Fallbacks mirror ``solve_scheduling``: a cost table outside the auction's
+integer domain (checked on device, read back with the result batch) or an
+uncertified solve degrades to the C++ CPU oracle — one extra download of
+the priced arc table, only on the rare round that needs it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poseidon_tpu.graph.builder import GraphMeta
+from poseidon_tpu.graph.network import FlowNetwork, pad_bucket
+from poseidon_tpu.models import get_cost_model
+from poseidon_tpu.models.costs import build_cost_inputs_host
+from poseidon_tpu.ops.dense_auction import (
+    I32,
+    INF,
+    MAX_SCALED_COST,
+    DenseInstance,
+    DenseState,
+    _densify,
+    solve_dense,
+)
+from poseidon_tpu.ops.transport import (
+    CH_CLUSTER,
+    CH_PREF,
+    CH_UNSCHED,
+    NotSchedulingShaped,
+    TransportTopology,
+    extract_topology,
+    instance_from_topology,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DenseTopology:
+    """Padded device copy of the TransportTopology index maps.
+
+    Index value -1 marks padding / absent arcs; gathers clip and mask.
+    ``n_tasks`` is a traced scalar so one compiled program serves every
+    round within a (Tp, Mp, P) bucket.
+    """
+
+    arc_unsched: jax.Array   # i32[Tp]
+    arc_cluster: jax.Array   # i32[Tp]
+    arc_u2s: jax.Array       # i32[Tp]
+    arc_pref: jax.Array      # i32[Tp, P]
+    pref_machine: jax.Array  # i32[Tp, P]
+    pref_rack: jax.Array     # i32[Tp, P]
+    arc_c2m: jax.Array       # i32[Mp]
+    arc_r2m: jax.Array       # i32[Mp]
+    arc_m2s: jax.Array       # i32[Mp]
+    rack_of: jax.Array       # i32[Mp]
+    slots: jax.Array         # i32[Mp] (0 on padding)
+    n_tasks: jax.Array       # i32 scalar
+
+
+def pad_topology(topo: TransportTopology) -> DenseTopology:
+    """Host-side padding of the skeleton (numpy; upload happens batched)."""
+    T, M, P = topo.n_tasks, topo.n_machines, topo.max_prefs
+    Tp, Mp = pad_bucket(max(T, 1)), pad_bucket(max(M, 1))
+
+    def pad1(x, size, fill):
+        out = np.full(size, fill, np.int32)
+        out[: len(x)] = x
+        return out
+
+    def pad2(x, shape, fill):
+        out = np.full(shape, fill, np.int32)
+        out[: x.shape[0], : x.shape[1]] = x
+        return out
+
+    return DenseTopology(
+        arc_unsched=pad1(topo.arc_unsched, Tp, -1),
+        arc_cluster=pad1(topo.arc_cluster, Tp, -1),
+        arc_u2s=pad1(topo.arc_u2s, Tp, -1),
+        arc_pref=pad2(topo.arc_pref, (Tp, P), -1),
+        pref_machine=pad2(topo.pref_machine, (Tp, P), -1),
+        pref_rack=pad2(topo.pref_rack, (Tp, P), -1),
+        arc_c2m=pad1(topo.arc_c2m, Mp, -1),
+        arc_r2m=pad1(topo.arc_r2m, Mp, -1),
+        arc_m2s=pad1(topo.arc_m2s, Mp, -1),
+        rack_of=pad1(topo.rack_of, Mp, -1),
+        slots=pad1(topo.slots, Mp, 0),
+        n_tasks=np.int32(T),
+    )
+
+
+@partial(jax.jit, static_argnames=("n_prefs", "smax"))
+def _redensify(dt: DenseTopology, cost: jax.Array, n_prefs: int, smax: int):
+    """Gather the priced arc table into a scaled DenseInstance, on device.
+
+    Returns (DenseInstance, domain_ok, pc_scaled, ra_scaled). The domain
+    check (non-negative costs, 2*cmax*(T+1) < MAX_SCALED_COST) is a
+    device boolean read back with the result batch — the device-side
+    analog of ``build_dense_instance``'s CostDomainTooLarge guard.
+    """
+    Tp = dt.arc_unsched.shape[0]
+    scale = dt.n_tasks + 1
+
+    def gat(idx, fill):
+        return jnp.where(
+            idx >= 0, cost[jnp.maximum(idx, 0)], jnp.int32(fill)
+        )
+
+    g = gat(dt.arc_m2s, INF)                      # [Mp] m->sink leg
+    d_u = jnp.minimum(gat(dt.arc_c2m, INF) + g, INF)
+    ra_u = jnp.minimum(gat(dt.arc_r2m, INF) + g, INF)
+    u_u = gat(dt.arc_unsched, 0) + gat(dt.arc_u2s, 0)   # 0 on padding
+    w_u = gat(dt.arc_cluster, INF)
+    pm_leg = jnp.where(
+        dt.pref_machine >= 0, g[jnp.maximum(dt.pref_machine, 0)], 0
+    )
+    pc_u = jnp.minimum(gat(dt.arc_pref, INF) + pm_leg, INF)
+
+    # integer-domain guard, in int64 (call sites run under enable_x64)
+    def finmax(x):
+        return jnp.max(jnp.where(x < INF, x, 0))
+
+    def finmin(x):
+        return jnp.min(jnp.where(x < INF, x, 0))
+
+    cmax_u = jnp.maximum(
+        jnp.maximum(jnp.maximum(finmax(u_u), finmax(w_u)), finmax(pc_u)),
+        jnp.maximum(finmax(d_u), finmax(ra_u)),
+    )
+    cmin_u = jnp.minimum(
+        jnp.minimum(jnp.minimum(finmin(u_u), finmin(w_u)), finmin(pc_u)),
+        jnp.minimum(finmin(d_u), finmin(ra_u)),
+    )
+    cmax_scaled = (
+        2 * cmax_u.astype(jnp.int64) * scale.astype(jnp.int64)
+    )
+    domain_ok = (cmin_u >= 0) & (cmax_scaled < MAX_SCALED_COST)
+
+    def sc(x):
+        # the x*scale lanes where x is INF-saturated may wrap; the
+        # where() discards them before anything reads the value
+        return jnp.where(x >= INF, INF, x * scale).astype(I32)
+
+    u_s, w_s, d_s, ra_s = sc(u_u), sc(w_u), sc(d_u), sc(ra_u)
+    pc_s = sc(pc_u)
+    task_valid = jnp.arange(Tp, dtype=I32) < dt.n_tasks
+    u_s = jnp.where(task_valid, u_s, 0)
+
+    c = _densify(
+        w_s, d_s, ra_s, dt.rack_of, dt.slots, pc_s,
+        dt.pref_machine, dt.pref_rack, n_prefs=n_prefs,
+    )
+    dev = DenseInstance(
+        c=c,
+        u=u_s,
+        w=w_s,
+        dgen=d_s,
+        s=dt.slots,
+        task_valid=task_valid,
+        scale=scale.astype(I32),
+        cmax=jnp.minimum(cmax_scaled, INF - 1).astype(I32),
+        smax=smax,
+    )
+    return dev, domain_ok, pc_s, ra_s
+
+
+@jax.jit
+def _finalize(dev: DenseInstance, dt: DenseTopology, pc_s, ra_s, asg):
+    """Channel codes + scaled primal objective for a final assignment."""
+    Tp, Mp = dev.c.shape
+    P = pc_s.shape[1]
+    on = (asg >= 0) & (asg < Mp) & dev.task_valid
+    m = jnp.clip(asg, 0, Mp - 1)
+    best = jnp.where(on, jnp.minimum(dev.w + dev.dgen[m], INF), INF)
+    ch = jnp.where(on, CH_CLUSTER, CH_UNSCHED).astype(I32)
+    for k in range(P):
+        pm = dt.pref_machine[:, k]
+        pr = dt.pref_rack[:, k]
+        pck = pc_s[:, k]
+        val = jnp.where(on & (pm == asg), pck, INF)
+        hit_r = on & (pr >= 0) & (pr == dt.rack_of[m])
+        val = jnp.minimum(
+            val,
+            jnp.where(hit_r, jnp.minimum(pck + ra_s[m], INF), INF),
+        )
+        better = val < best
+        best = jnp.where(better, val, best)
+        ch = jnp.where(better, CH_PREF + k, ch)
+    c_asg = jnp.take_along_axis(dev.c, m[:, None], axis=1)[:, 0]
+    per = jnp.where(dev.task_valid, jnp.where(on, c_asg, dev.u), 0)
+    primal = jnp.sum(per.astype(jnp.int64))
+    return ch, primal
+
+
+_MODEL_JIT_CACHE: dict[object, object] = {}
+
+
+def _jitted_model(name: str):
+    """Jit each registry cost model once (fresh jax.jit wrappers per
+    round would re-trace every call). Keyed by the function object, not
+    the name, so re-registering a name in COST_MODELS takes effect."""
+    fn = get_cost_model(name)
+    jitted = _MODEL_JIT_CACHE.get(fn)
+    if jitted is None:
+        jitted = jax.jit(fn)
+        _MODEL_JIT_CACHE[fn] = jitted
+    return jitted
+
+
+@dataclasses.dataclass
+class ResidentOutcome:
+    """One resident round's result, fully host-side."""
+
+    assignment: np.ndarray   # int32[T] machine index or -1
+    channel: np.ndarray      # int32[T] CH_* code
+    cost: int                # exact unscaled objective
+    backend: str             # "dense_auction" | "oracle:<why>"
+    converged: bool
+    rounds: int
+    phases: int
+    # None only on a non-taxonomy graph (oracle path); without it the
+    # outcome cannot be flow-decomposed
+    topology: TransportTopology | None
+    timings: dict[str, float]
+
+
+class ResidentSolver:
+    """Owns the device-resident solve chain + warm state across rounds.
+
+    One instance per scheduling loop (the bridge holds it). Warm state
+    (``DenseState``) lives on HBM between rounds; it survives task-set
+    churn because a stale assignment is only a starting point — the
+    auction's violator release + certificate repair it exactly (the trim
+    in ``_solve`` enforces capacity before the loop).
+    """
+
+    def __init__(
+        self,
+        *,
+        alpha: int = 4,
+        max_rounds: int = 20_000,
+        oracle_fallback: bool = True,
+        oracle_timeout_s: float = 1000.0,
+    ):
+        self.alpha = alpha
+        self.max_rounds = max_rounds
+        self.oracle_fallback = oracle_fallback
+        self.oracle_timeout_s = oracle_timeout_s
+        self._warm: DenseState | None = None
+
+    def reset(self) -> None:
+        self._warm = None
+
+    @property
+    def warm(self) -> DenseState | None:
+        """The on-HBM warm handle carried across rounds (None = cold)."""
+        return self._warm
+
+    def run_round(
+        self,
+        arrays: dict[str, np.ndarray],
+        meta: GraphMeta,
+        *,
+        cost_model: str,
+        cost_input_kwargs: dict | None = None,
+    ) -> ResidentOutcome:
+        """One full scheduling round from builder host arrays.
+
+        ``arrays`` is ``FlowGraphBuilder.build_arrays``'s output;
+        ``cost_input_kwargs`` are the KnowledgeBase aggregates passed to
+        ``build_cost_inputs_host``.
+        """
+        timings: dict[str, float] = {}
+        t0 = time.perf_counter()
+        E = pad_bucket(max(meta.n_arcs, 1))
+        inputs_host = build_cost_inputs_host(
+            E, meta, **(cost_input_kwargs or {})
+        )
+        try:
+            topo = extract_topology(
+                meta, arrays["src"], arrays["dst"], arrays["cap"]
+            )
+        except NotSchedulingShaped:
+            # not a builder-taxonomy graph: price it anyway (the models
+            # only need the arc metadata) and solve on the oracle, the
+            # same degradation solve_scheduling provides
+            inputs_dev = jax.device_put(inputs_host)
+            cost = _jitted_model(cost_model)(inputs_dev)
+            return self._oracle_round(
+                arrays, meta, None, cost, timings,
+                why="not-scheduling-shaped",
+            )
+        T, P = topo.n_tasks, topo.max_prefs
+        dt_host = pad_topology(topo)
+        # power-of-two smax bound: top_k cost grows mildly with smax but
+        # the static argument stays stable as per-round free slots churn
+        smax = min(
+            pad_bucket(max(int(topo.slots.max(initial=1)), 1), minimum=1),
+            dt_host.arc_unsched.shape[0],
+        )
+        timings["prep_ms"] = (time.perf_counter() - t0) * 1000
+
+        # ---- ONE batched upload --------------------------------------
+        t0 = time.perf_counter()
+        inputs_dev, dt = jax.device_put((inputs_host, dt_host))
+        jax.block_until_ready(dt.arc_unsched)
+        timings["upload_ms"] = (time.perf_counter() - t0) * 1000
+
+        # ---- device-side chain, no host crossings --------------------
+        t0 = time.perf_counter()
+        cost = _jitted_model(cost_model)(inputs_dev)
+        with jax.enable_x64(True):
+            dev, domain_ok, pc_s, ra_s = _redensify(
+                dt, cost, n_prefs=P, smax=smax
+            )
+        state = solve_dense(
+            dev, warm=self._warm, alpha=self.alpha,
+            max_rounds=self.max_rounds,
+        )
+        with jax.enable_x64(True):
+            ch_dev, primal = _finalize(dev, dt, pc_s, ra_s, state.asg)
+        jax.block_until_ready(state.asg)
+        timings["solve_ms"] = (time.perf_counter() - t0) * 1000
+
+        # ---- ONE batched download ------------------------------------
+        t0 = time.perf_counter()
+        asg_np, ch_np, conv, rounds, phases, primal_np, dom_ok = (
+            jax.device_get((
+                state.asg, ch_dev, state.converged, state.rounds,
+                state.phases, primal, domain_ok,
+            ))
+        )
+        timings["fetch_ms"] = (time.perf_counter() - t0) * 1000
+
+        if not bool(dom_ok):
+            self._warm = None
+            return self._oracle_round(
+                arrays, meta, topo, cost, timings, why="cost-domain"
+            )
+        if not bool(conv) and self._warm is not None:
+            # stale warm start stranded the eps=1 settle: retry cold
+            # (its solve + second download land in the same timing
+            # columns — this round really does pay twice)
+            self._warm = None
+            t0 = time.perf_counter()
+            state = solve_dense(
+                dev, warm=None, alpha=self.alpha,
+                max_rounds=self.max_rounds,
+            )
+            with jax.enable_x64(True):
+                ch_dev, primal = _finalize(dev, dt, pc_s, ra_s, state.asg)
+            jax.block_until_ready(state.asg)
+            timings["solve_ms"] += (time.perf_counter() - t0) * 1000
+            t0 = time.perf_counter()
+            asg_np, ch_np, conv, rounds, phases, primal_np = (
+                jax.device_get((
+                    state.asg, ch_dev, state.converged, state.rounds,
+                    state.phases, primal,
+                ))
+            )
+            timings["fetch_ms"] += (time.perf_counter() - t0) * 1000
+        if not bool(conv):
+            self._warm = None
+            return self._oracle_round(
+                arrays, meta, topo, cost, timings, why="uncertified"
+            )
+
+        self._warm = state
+        Mp = dt_host.arc_m2s.shape[0]
+        asg = np.asarray(asg_np[:T], np.int32)
+        asg = np.where(
+            (asg >= 0) & (asg < Mp) & (asg < topo.n_machines), asg, -1
+        ).astype(np.int32)
+        return ResidentOutcome(
+            assignment=asg,
+            channel=np.asarray(ch_np[:T], np.int32),
+            cost=int(primal_np) // (T + 1),
+            backend="dense_auction",
+            converged=True,
+            rounds=int(rounds),
+            phases=int(phases),
+            topology=topo,
+            timings=timings,
+        )
+
+    def _oracle_round(
+        self, arrays, meta, topo, cost_dev, timings, *, why: str
+    ) -> ResidentOutcome:
+        """Degrade one round to the C++ oracle (downloads the arc table).
+
+        ``topo`` is None on a non-taxonomy graph — the outcome then
+        carries no topology and cannot be flow-decomposed via
+        ``flows_from_assignment`` (its channel codes are -1).
+        """
+        if not self.oracle_fallback:
+            raise RuntimeError(
+                f"resident solve failed ({why}) and oracle fallback is "
+                f"disabled"
+            )
+        from poseidon_tpu.graph.decompose import extract_placements
+        from poseidon_tpu.oracle import solve_oracle
+
+        t0 = time.perf_counter()
+        cost_host = np.asarray(
+            jax.device_get(cost_dev), np.int32
+        )[: meta.n_arcs]
+        net = FlowNetwork.from_arrays(
+            arrays["src"], arrays["dst"], arrays["cap"], cost_host,
+            arrays["supply"],
+        )
+        o = solve_oracle(
+            net, algorithm="cost_scaling", timeout_s=self.oracle_timeout_s
+        )
+        placements = extract_placements(
+            np.asarray(o.flows, np.int64), meta,
+            arrays["src"], arrays["dst"],
+        )
+        T = len(meta.task_uids)
+        midx = {name: i for i, name in enumerate(meta.machine_names)}
+        asg = np.full(T, -1, np.int32)
+        for i, uid in enumerate(meta.task_uids):
+            m = placements.get(uid)
+            if m is not None:
+                asg[i] = midx[m]
+        if topo is not None:
+            # real channel codes, so the outcome remains
+            # flow-decomposable just like a dense one
+            from poseidon_tpu.ops.dense_auction import _channels_for
+
+            channel = _channels_for(
+                instance_from_topology(topo, cost_host), asg
+            )
+        else:
+            channel = np.full(T, -1, np.int32)
+        timings["oracle_ms"] = (time.perf_counter() - t0) * 1000
+        return ResidentOutcome(
+            assignment=asg,
+            channel=channel,
+            cost=int(o.cost),
+            backend=f"oracle:{why}",
+            converged=True,
+            rounds=0,
+            phases=0,
+            topology=topo,
+            timings=timings,
+        )

@@ -45,6 +45,51 @@ class NotSchedulingShaped(ValueError):
 
 
 @dataclasses.dataclass(frozen=True)
+class TransportTopology:
+    """The cost-free skeleton of a scheduling graph: index maps + slots.
+
+    This is everything ``extract_instance`` derives that does NOT depend
+    on arc costs — the per-round-stable part. The device-resident solve
+    path (ops/resident.py) uploads these index arrays and gathers the
+    priced arc table on device, so repricing a round never crosses the
+    host boundary (the TPU analog of the reference's graph-change
+    batching seam, deploy/poseidon.cfg:12-19).
+    """
+
+    # per task
+    job_of: np.ndarray        # int32[T] job index (unsched aggregator)
+    arc_unsched: np.ndarray   # int32[T] task->unsched arc
+    arc_cluster: np.ndarray   # int32[T] task->cluster arc
+    arc_u2s: np.ndarray       # int32[T] unsched_j->sink arc for t's job
+    # prefs, padded [T, P]
+    arc_pref: np.ndarray      # int32[T, P] pref arc or -1
+    pref_machine: np.ndarray  # int32[T, P] machine index or -1
+    pref_rack: np.ndarray     # int32[T, P] rack index or -1
+    # per machine
+    arc_c2m: np.ndarray       # int32[M] cluster->machine arc or -1
+    arc_r2m: np.ndarray       # int32[M] rack->machine arc or -1
+    arc_m2s: np.ndarray       # int32[M] machine->sink arc or -1
+    rack_of: np.ndarray       # int32[M] rack index or -1
+    slots: np.ndarray         # int32[M] free slot capacity
+    # per job (unsched aggregator)
+    arc_job_sink: np.ndarray  # int32[J] unsched_j->sink arc
+    job_sink_cap: np.ndarray  # int64[J] unsched_j->sink capacity
+    n_racks: int
+
+    @property
+    def n_tasks(self) -> int:
+        return self.arc_unsched.shape[0]
+
+    @property
+    def n_machines(self) -> int:
+        return self.arc_m2s.shape[0]
+
+    @property
+    def max_prefs(self) -> int:
+        return self.arc_pref.shape[1]
+
+
+@dataclasses.dataclass(frozen=True)
 class TransportInstance:
     """Compact transportation form of a scheduling flow graph.
 
@@ -95,23 +140,27 @@ class TransportInstance:
         return self.pref_cost.shape[1]
 
 
-def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
-    """Validate the builder taxonomy and compact it to transportation form.
+def extract_topology(
+    meta: GraphMeta,
+    src: np.ndarray,
+    dst: np.ndarray,
+    cap: np.ndarray,
+) -> TransportTopology:
+    """Validate the builder taxonomy and derive the cost-free skeleton.
 
-    Raises NotSchedulingShaped if the arc table does not match the
-    builder's shape contract (in which case callers fall back to the
+    ``src``/``dst``/``cap`` are host arrays over the REAL arcs (no
+    padding). Raises NotSchedulingShaped if the arc table does not match
+    the builder's shape contract (in which case callers fall back to the
     general solvers).
     """
-    if int(net.n_arcs) != int(meta.n_arcs) or int(net.n_nodes) != int(
-        meta.n_nodes
-    ):
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    cap = np.asarray(cap, np.int64)
+    if len(src) != meta.n_arcs or len(cap) != meta.n_arcs:
         raise NotSchedulingShaped(
-            f"network ({net.n_nodes} nodes / {net.n_arcs} arcs) does not "
-            f"match the builder metadata ({meta.n_nodes} / {meta.n_arcs})"
+            f"arc arrays ({len(src)}) do not match the builder metadata "
+            f"({meta.n_arcs})"
         )
-    host = net.to_host()
-    cost = host["cost"].astype(np.int64)
-    cap = host["cap"].astype(np.int64)
     kind = meta.arc_kind
     T, M = len(meta.task_uids), len(meta.machine_names)
     R = len(meta.rack_names)
@@ -126,6 +175,8 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
         keys = np.asarray(keys)
         if (keys < 0).any():
             raise NotSchedulingShaped(f"unlabeled {label} arc")
+        if (keys >= n).any():
+            raise NotSchedulingShaped(f"{label} arc label out of range")
         counts = np.bincount(keys, minlength=n)
         if (counts > 1).any():
             raise NotSchedulingShaped(f"duplicate {label} arc")
@@ -138,30 +189,28 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     # machine -> sink: the binding capacity
     m2s = arcs_of(ArcKind.MACHINE_TO_SINK)
     arc_m2s = unique_per_key(m2s, meta.arc_machine[m2s], M, "machine->sink")
-    g = cost[arc_m2s]
     slots = cap[arc_m2s].astype(np.int32)
 
     c2m = arcs_of(ArcKind.CLUSTER_TO_MACHINE)
     arc_c2m = unique_per_key(
         c2m, meta.arc_machine[c2m], M, "cluster->machine"
     )
-    d = cost[arc_c2m] + g
     if (cap[arc_c2m] != slots).any():
         raise NotSchedulingShaped("cluster->machine cap != machine slots")
 
     # rack -> machine is optional per machine
     r2m = arcs_of(ArcKind.RACK_TO_MACHINE)
     arc_r2m = np.full(M, -1, np.int32)
-    ra = np.full(M, INF, np.int64)
     rack_of = np.full(M, -1, np.int32)
     if len(r2m):
         rm = meta.arc_machine[r2m]
         if (rm < 0).any():
             raise NotSchedulingShaped("unlabeled rack->machine arc")
+        if (rm >= M).any():
+            raise NotSchedulingShaped("rack->machine arc label out of range")
         if np.bincount(rm, minlength=M).max(initial=0) > 1:
             raise NotSchedulingShaped("duplicate rack->machine arc")
         arc_r2m[rm] = r2m
-        ra[rm] = cost[r2m] + g[rm]
         rack_of[rm] = meta.arc_rack[r2m]
         if (cap[r2m] != slots[rm]).any():
             raise NotSchedulingShaped("rack->machine cap != machine slots")
@@ -169,38 +218,32 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     # unsched aggregators: task->unsched + unsched->sink
     u2s = arcs_of(ArcKind.UNSCHED_TO_SINK)
     J = len(u2s)
-    job_sink_cost = cost[u2s] if J else np.zeros(0, np.int64)
     job_sink_cap = cap[u2s] if J else np.zeros(0, np.int64)
     # map aggregator node id -> job index via a dense node lookup
     node_job = np.full(meta.n_nodes, -1, np.int32)
-    node_job[host["src"][u2s].astype(np.int64)] = np.arange(
-        J, dtype=np.int32
-    )
+    node_job[src[u2s].astype(np.int64)] = np.arange(J, dtype=np.int32)
 
     t2u = arcs_of(ArcKind.TASK_TO_UNSCHED)
     arc_unsched = unique_per_key(
         t2u, meta.arc_task[t2u], T, "task->unsched"
     )
-    drain = host["dst"][arc_unsched].astype(np.int64)
+    drain = dst[arc_unsched].astype(np.int64)
     job_of = node_job[drain]
     if (job_of < 0).any():
         raise NotSchedulingShaped("unsched arc without aggregator drain")
-    tu = cost[arc_unsched]
-    u = tu + job_sink_cost[job_of]
     arc_u2s = u2s[job_of].astype(np.int32)
 
     t2c = arcs_of(ArcKind.TASK_TO_CLUSTER)
     arc_cluster = unique_per_key(
         t2c, meta.arc_task[t2c], T, "task->cluster"
     )
-    w = cost[arc_cluster]
 
     # preference arcs, ragged -> padded [T, P] (rank by stable sort)
     tm = arcs_of(ArcKind.TASK_TO_MACHINE)
     tr = arcs_of(ArcKind.TASK_TO_RACK)
     pa = np.concatenate([tm, tr]).astype(np.int32)
     pt = np.concatenate([meta.arc_task[tm], meta.arc_task[tr]])
-    if len(pa) and (pt < 0).any():
+    if len(pa) and ((pt < 0).any() or (pt >= T).any()):
         raise NotSchedulingShaped("unlabeled preference arc")
     pm = np.concatenate(
         [meta.arc_machine[tm], np.full(len(tr), -1, np.int32)]
@@ -208,12 +251,9 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     pr = np.concatenate(
         [np.full(len(tm), -1, np.int32), meta.arc_rack[tr]]
     )
-    pc = np.concatenate(
-        [cost[tm] + g[np.maximum(meta.arc_machine[tm], 0)], cost[tr]]
-    ) if len(pa) else np.zeros(0, np.int64)
     if len(pa):
         order = np.argsort(pt, kind="stable")
-        pt, pm, pr, pc, pa = pt[order], pm[order], pr[order], pc[order], pa[order]
+        pt, pm, pr, pa = pt[order], pm[order], pr[order], pa[order]
         counts = np.bincount(pt, minlength=T)
         P = max(int(counts.max(initial=0)), 1)
         starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
@@ -221,12 +261,10 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     else:
         P = 1
         rank = np.zeros(0, np.int64)
-    pref_cost = np.full((T, P), INF, np.int64)
     pref_machine = np.full((T, P), -1, np.int32)
     pref_rack = np.full((T, P), -1, np.int32)
     arc_pref = np.full((T, P), -1, np.int32)
     if len(pa):
-        pref_cost[pt, rank] = pc
         pref_machine[pt, rank] = pm
         pref_rack[pt, rank] = pr
         arc_pref[pt, rank] = pa
@@ -239,15 +277,70 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
         raise NotSchedulingShaped(
             f"arc table has {meta.n_arcs - labeled} arcs outside the taxonomy"
         )
-    return TransportInstance(
-        u=u, w=w, pref_cost=pref_cost, pref_machine=pref_machine,
-        pref_rack=pref_rack, d=d, ra=ra, slots=slots, rack_of=rack_of,
-        g=g, tu=tu, job_of=job_of, job_sink_cost=job_sink_cost,
-        job_sink_cap=job_sink_cap,
-        arc_unsched=arc_unsched, arc_cluster=arc_cluster, arc_pref=arc_pref,
-        arc_c2m=arc_c2m, arc_r2m=arc_r2m, arc_m2s=arc_m2s, arc_u2s=arc_u2s,
+    return TransportTopology(
+        job_of=job_of, arc_unsched=arc_unsched, arc_cluster=arc_cluster,
+        arc_u2s=arc_u2s, arc_pref=arc_pref, pref_machine=pref_machine,
+        pref_rack=pref_rack, arc_c2m=arc_c2m, arc_r2m=arc_r2m,
+        arc_m2s=arc_m2s, rack_of=rack_of, slots=slots,
+        arc_job_sink=u2s.astype(np.int32), job_sink_cap=job_sink_cap,
         n_racks=R,
     )
+
+
+def instance_from_topology(
+    topo: TransportTopology, cost: np.ndarray
+) -> TransportInstance:
+    """Fill a topology skeleton with host arc costs -> TransportInstance."""
+    cost = np.asarray(cost, np.int64)
+    g = cost[topo.arc_m2s]
+    d = cost[topo.arc_c2m] + g
+    ra = np.where(
+        topo.arc_r2m >= 0,
+        cost[np.maximum(topo.arc_r2m, 0)] + g,
+        INF,
+    )
+    jsc = cost[topo.arc_job_sink]
+    tu = cost[topo.arc_unsched]
+    u = tu + cost[topo.arc_u2s]
+    w = cost[topo.arc_cluster]
+    mp = topo.pref_machine
+    pref_cost = np.where(
+        topo.arc_pref >= 0,
+        cost[np.maximum(topo.arc_pref, 0)]
+        + np.where(mp >= 0, g[np.maximum(mp, 0)], 0),
+        INF,
+    )
+    return TransportInstance(
+        u=u, w=w, pref_cost=pref_cost, pref_machine=topo.pref_machine,
+        pref_rack=topo.pref_rack, d=d, ra=ra, slots=topo.slots,
+        rack_of=topo.rack_of, g=g, tu=tu, job_of=topo.job_of,
+        job_sink_cost=jsc, job_sink_cap=topo.job_sink_cap,
+        arc_unsched=topo.arc_unsched, arc_cluster=topo.arc_cluster,
+        arc_pref=topo.arc_pref, arc_c2m=topo.arc_c2m,
+        arc_r2m=topo.arc_r2m, arc_m2s=topo.arc_m2s, arc_u2s=topo.arc_u2s,
+        n_racks=topo.n_racks,
+    )
+
+
+def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
+    """Validate the builder taxonomy and compact it to transportation form.
+
+    Raises NotSchedulingShaped if the arc table does not match the
+    builder's shape contract (in which case callers fall back to the
+    general solvers). This host path downloads the priced arc table from
+    device (one ~100 ms tunnel crossing); the per-round production loop
+    uses the device-resident path in ops/resident.py instead.
+    """
+    if int(net.n_arcs) != int(meta.n_arcs) or int(net.n_nodes) != int(
+        meta.n_nodes
+    ):
+        raise NotSchedulingShaped(
+            f"network ({net.n_nodes} nodes / {net.n_arcs} arcs) does not "
+            f"match the builder metadata ({meta.n_nodes} / {meta.n_arcs})"
+        )
+    host = net.to_host()
+    topo = extract_topology(meta, host["src"], host["dst"], host["cap"])
+    return instance_from_topology(topo, host["cost"])
 
 
 @dataclasses.dataclass(frozen=True)

@@ -122,3 +122,168 @@ class TestTransportDuplicateGuards:
         )
         with pytest.raises(NotSchedulingShaped):
             extract_instance(net, meta)
+
+
+# ---- round-3 advisor findings (fixed round 4) -------------------------
+
+
+class TestBridgeStaleBindingNotReadopted:
+    """ADVICE r3 (medium): a pod the apiserver still reports RUNNING on
+    a removed node must stay Pending, not re-adopt the ghost binding."""
+
+    def _bridge(self):
+        from poseidon_tpu.bridge.bridge import SchedulerBridge
+        from poseidon_tpu.cluster import Machine, Task, TaskPhase
+
+        b = SchedulerBridge(cost_model="trivial")
+        b.observe_nodes([Machine(name="m0"), Machine(name="m1")])
+        b.observe_pods([
+            Task(uid="p0", phase=TaskPhase.RUNNING, machine="m1"),
+        ])
+        return b
+
+    def test_running_pod_on_removed_node_stays_pending(self):
+        from poseidon_tpu.cluster import Machine, Task, TaskPhase
+
+        b = self._bridge()
+        assert b.tasks["p0"].phase == TaskPhase.RUNNING
+        # the node disappears: eviction flips the task to Pending
+        b.observe_nodes([Machine(name="m0")])
+        assert b.tasks["p0"].phase == TaskPhase.PENDING
+        # apiserver's watch cache is stale: it still reports the pod
+        # Running on m1. The bridge must NOT re-adopt the ghost binding.
+        b.observe_pods([
+            Task(uid="p0", phase=TaskPhase.RUNNING, machine="m1"),
+        ])
+        assert b.tasks["p0"].phase == TaskPhase.PENDING
+        assert b.tasks["p0"].machine == ""
+        assert "p0" not in b.pod_to_machine
+
+    def test_wait_rounds_preserved_across_stale_polls(self):
+        import dataclasses
+
+        from poseidon_tpu.cluster import Machine, Task, TaskPhase
+
+        b = self._bridge()
+        b.observe_nodes([Machine(name="m0")])
+        b.tasks["p0"] = dataclasses.replace(b.tasks["p0"], wait_rounds=7)
+        b.observe_pods([
+            Task(uid="p0", phase=TaskPhase.RUNNING, machine="m1"),
+        ])
+        assert b.tasks["p0"].wait_rounds == 7
+
+    def test_restart_adoption_of_live_node_still_works(self):
+        from poseidon_tpu.cluster import TaskPhase
+
+        b = self._bridge()  # m1 exists: adoption is the correct path
+        assert b.tasks["p0"].phase == TaskPhase.RUNNING
+        assert b.pod_to_machine["p0"] == "m1"
+
+
+class TestAgingStaysInsideAuctionDomain:
+    """ADVICE r3 (medium): unbounded wait-rounds aging must not blow the
+    dense auction's scaled-cost guard at flagship task counts."""
+
+    def test_wait_cap_bounds_model_costs(self):
+        import jax.numpy as jnp
+
+        from poseidon_tpu.graph.builder import FlowGraphBuilder
+        from poseidon_tpu.models import build_cost_inputs, get_cost_model
+        from poseidon_tpu.models.costs import _SCALE, WAIT_CAP
+
+        cluster = random_cluster(np.random.default_rng(11), 5, 30)
+        net, meta = FlowGraphBuilder().build(cluster)
+        meta.task_wait[:] = 10**6  # pathologically starved
+        inputs = build_cost_inputs(net, meta)
+        for model in ("quincy", "coco"):
+            costs = get_cost_model(model)(inputs)
+            cap = 2500 + 5 * _SCALE * (WAIT_CAP + 1)
+            assert int(jnp.max(costs)) <= cap, model
+
+    def test_flagship_domain_admits_capped_aging(self):
+        """The guard 2*cmax*(T+1) < MAX_SCALED_COST must hold for the
+        capped worst-case aging cost at the flagship T = 10k."""
+        from poseidon_tpu.models.costs import _SCALE, COST_CAP, WAIT_CAP
+        from poseidon_tpu.ops.dense_auction import MAX_SCALED_COST
+
+        from poseidon_tpu.models.costs import DOMAIN_SAFE_COST
+
+        t_flagship = 10_000
+        quincy_aging_worst = 5 * _SCALE * (WAIT_CAP + 1)
+        quincy_data_worst = DOMAIN_SAFE_COST  # task_input clamp + _SCALE
+        coco_worst = COST_CAP // 4 + 5 * _SCALE * WAIT_CAP
+        for worst in (quincy_aging_worst, quincy_data_worst, coco_worst):
+            assert 2 * worst * (t_flagship + 1) < MAX_SCALED_COST
+
+    def test_task_input_clamped_to_domain(self):
+        """Huge locality weights (data-dependent, unbounded upstream)
+        must not push quincy's cluster arc past the flagship ceiling."""
+        from poseidon_tpu.cluster import ClusterState, Machine, Task
+        from poseidon_tpu.graph.builder import FlowGraphBuilder
+        from poseidon_tpu.models import build_cost_inputs, get_cost_model
+        from poseidon_tpu.models.costs import DOMAIN_SAFE_COST
+
+        cluster = ClusterState(
+            machines=[Machine(name="m0"), Machine(name="m1")],
+            tasks=[Task(uid="t0", data_prefs={"m0": 10**6, "m1": 10**6})],
+        )
+        net, meta = FlowGraphBuilder().build(cluster)
+        inputs = build_cost_inputs(net, meta)
+        costs = get_cost_model("quincy")(inputs)
+        import jax.numpy as jnp
+
+        assert int(jnp.max(costs)) <= DOMAIN_SAFE_COST
+
+    def test_starved_flagship_round_stays_on_dense_path(self):
+        """End-to-end: heavily-aged tasks still solve on the TPU dense
+        path (no CostDomainTooLarge -> oracle demotion)."""
+        from poseidon_tpu.graph.builder import FlowGraphBuilder
+        from poseidon_tpu.ops.transport import extract_instance
+        from poseidon_tpu.ops.dense_auction import build_dense_instance
+        from poseidon_tpu.solver import solve_scheduling
+
+        cluster = random_cluster(np.random.default_rng(13), 6, 40)
+        net, meta = FlowGraphBuilder().build(cluster)
+        meta.task_wait[:] = 500  # way past WAIT_CAP
+        net = price(net, meta, "quincy", cluster)
+        build_dense_instance(extract_instance(net, meta))  # no raise
+        outcome = solve_scheduling(net, meta)
+        assert outcome.backend == "dense_auction"
+
+
+class TestTransportLabelRangeGuard:
+    """ADVICE r3 (low): out-of-range labels raise NotSchedulingShaped,
+    not IndexError."""
+
+    def test_out_of_range_machine_label(self):
+        from poseidon_tpu.graph.builder import ArcKind, FlowGraphBuilder
+
+        cluster = random_cluster(np.random.default_rng(17), 5, 20)
+        net, meta = FlowGraphBuilder().build(cluster)
+        arcs = np.where(meta.arc_kind == int(ArcKind.MACHINE_TO_SINK))[0]
+        arr = meta.arc_machine.copy()
+        arr[arcs[0]] = len(meta.machine_names) + 3
+        object.__setattr__(meta, "arc_machine", arr)
+        with pytest.raises(NotSchedulingShaped):
+            extract_instance(net, meta)
+
+
+class TestPerturbCostsX64:
+    """ADVICE r3 (low): perturb_costs must run its int64 math under
+    enable_x64 — no silent truncation warnings."""
+
+    def test_no_truncation_warning(self):
+        import warnings
+
+        from poseidon_tpu.graph.builder import FlowGraphBuilder
+        from poseidon_tpu.ops.batch import solve_what_if
+        from poseidon_tpu.ops.transport import extract_instance
+
+        cluster = random_cluster(np.random.default_rng(23), 4, 12)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "quincy", cluster)
+        inst = extract_instance(net, meta)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            res = solve_what_if(inst, n_variants=3, seed=1)
+        assert res.converged.all()

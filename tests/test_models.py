@@ -177,3 +177,29 @@ class TestEndToEnd:
         # heavier load scenario -> uniformly pricier machine arcs
         assert (costs[-1][sink] >= costs[0][sink]).all()
         assert (costs[-1][sink] > costs[0][sink]).any()
+
+
+class TestKnowledgeRetirement:
+    def test_retired_rows_are_reused(self):
+        from poseidon_tpu.models.knowledge import KnowledgeBase, TaskSample
+
+        kb = KnowledgeBase(queue_size=4)
+        for i in range(1000):
+            uid = f"pod-{i}"
+            kb.add_task_sample(uid, TaskSample(cpu_usage=0.5, mem_usage_kb=1))
+            kb.retire_task(uid)
+        # churned uids reuse one freed row; storage must not have grown
+        assert kb._tasks._count.shape[0] == 256
+        assert len(kb._tasks._idx) == 0
+        # a retired uid reads as unsampled again
+        assert kb.task_cpu_usage(["pod-500"])[0] == 0.0
+
+    def test_retire_then_resample_is_clean(self):
+        from poseidon_tpu.models.knowledge import KnowledgeBase, MachineSample
+
+        kb = KnowledgeBase(queue_size=4)
+        kb.add_machine_sample("m", MachineSample(cpu_idle=0.0, mem_free_frac=0.0))
+        kb.retire_machine("m")
+        kb.add_machine_sample("m", MachineSample(cpu_idle=1.0, mem_free_frac=1.0))
+        assert kb.machine_cpu_idle(["m"])[0] == 1.0
+        assert kb.machine_mem_free(["m"])[0] == 1.0

@@ -33,5 +33,6 @@ PROXY_PID=$!
 trap 'kill ${PROXY_PID}' EXIT
 sleep 1
 
+# no exec: run.sh must stay a child so the EXIT trap can reap the proxy
 K8S_APISERVER_HOST=localhost K8S_APISERVER_PORT=8001 \
-  exec "${DIR}/run.sh" "$@"
+  "${DIR}/run.sh" "$@"

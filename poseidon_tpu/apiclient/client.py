@@ -13,7 +13,15 @@ Mirrors src/apiclient/k8s_api_client.{h,cc}: GET ``nodes`` / ``pods``
   ``default`` (k8s_api_client.cc:222);
 - transport errors raise ``ApiError`` after bounded retries instead of
   dissolving into logged JSON (utils.cc:47-61); the driver loop decides
-  to skip the tick.
+  to skip the tick;
+- list pagination is followed (``metadata.continue`` tokens, chunked via
+  ``limit``). The reference does one unpaginated GET and parses whatever
+  came back (k8s_api_client.cc:100-160); against an apiserver that
+  chunks its responses that silently drops every item after the first
+  page — and a dropped page reads as "those pods/nodes are gone", which
+  would mass-evict scheduler state. A page fetch that still fails after
+  retries raises instead of returning the partial list for the same
+  reason.
 
 Transport is stdlib urllib on purpose: the control plane is a few small
 JSON GETs per 10-second tick (deploy/poseidon.cfg / --polling_frequency),
@@ -88,10 +96,12 @@ class K8sApiClient:
         *,
         timeout_s: float = 10.0,
         retries: int = 2,
+        page_limit: int = 500,
     ):
         self.base = f"http://{host}:{port}/api/{api_version}"
         self.timeout_s = timeout_s
         self.retries = retries
+        self.page_limit = page_limit
         log.info("k8s api client -> %s", self.base)
 
     # ---- transport -----------------------------------------------------
@@ -121,17 +131,49 @@ class K8sApiClient:
                     time.sleep(0.05 * (attempt + 1))
         raise ApiError(f"{url}: {last}") from last
 
+    def _list(self, resource: str, selector: str = "") -> list[dict]:
+        """Chunked list: follow ``metadata.continue`` until exhausted.
+
+        All pages of one logical list are fetched before parsing; a page
+        failure (after per-request retries) raises so the caller never
+        sees a silently truncated snapshot — the bridge would read the
+        missing tail as mass deletion.
+        """
+        items: list[dict] = []
+        token = ""
+        # bounded like every other failure mode in this client: a server
+        # that replays the same continue token (or pages forever) must
+        # surface as a skipped tick, not a silent daemon hang
+        max_pages = 10_000
+        for _ in range(max_pages):
+            params: dict[str, str] = {}
+            if selector:
+                params["labelSelector"] = selector
+            if self.page_limit > 0:
+                params["limit"] = str(self.page_limit)
+            if token:
+                params["continue"] = token
+            path = resource
+            if params:
+                path += "?" + urllib.parse.urlencode(params)
+            doc = self._request(path)
+            items.extend(doc.get("items", []))
+            next_token = doc.get("metadata", {}).get("continue", "") or ""
+            if not next_token:
+                return items
+            if next_token == token:
+                raise ApiError(
+                    f"{resource}: apiserver replayed continue token "
+                    f"{token!r}"
+                )
+            token = next_token
+        raise ApiError(f"{resource}: pagination exceeded {max_pages} pages")
+
     # ---- nodes ---------------------------------------------------------
 
     def nodes_with_label(self, selector: str = "") -> list[Machine]:
-        path = "nodes"
-        if selector:
-            path += "?" + urllib.parse.urlencode(
-                {"labelSelector": selector}
-            )
-        doc = self._request(path)
         out = []
-        for item in doc.get("items", []):
+        for item in self._list("nodes", selector):
             try:
                 out.append(self._parse_node(item))
             except (KeyError, ValueError) as e:
@@ -168,14 +210,8 @@ class K8sApiClient:
     # ---- pods ----------------------------------------------------------
 
     def pods_with_label(self, selector: str = "") -> list[Task]:
-        path = "pods"
-        if selector:
-            path += "?" + urllib.parse.urlencode(
-                {"labelSelector": selector}
-            )
-        doc = self._request(path)
         out = []
-        for item in doc.get("items", []):
+        for item in self._list("pods", selector):
             try:
                 out.append(self._parse_pod(item))
             except (KeyError, ValueError) as e:

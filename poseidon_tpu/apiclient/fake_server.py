@@ -14,7 +14,13 @@ comments (k8s_api_client.cc:96-99) — but never builds the fixture
 
 Fault injection for resilience tests: ``fail_next(n)`` makes the next n
 requests return HTTP 500; ``drop_node(name)`` removes a node between
-polls (the node-removal path the reference never handled).
+polls (the node-removal path the reference never handled);
+``truncate_lists(n)`` serves only the first n items WITHOUT a continue
+token (a partial snapshot masquerading as complete — the failure mode
+the bridge's mass-eviction guard exists for).
+
+List requests honor ``limit``/``continue`` pagination the way the real
+apiserver chunks responses, so the client's token-following is testable.
 """
 
 from __future__ import annotations
@@ -35,6 +41,7 @@ class FakeApiServer:
         self.bindings: list[tuple[str, str]] = []
         self._pending_bindings: list[tuple[str, str]] = []
         self._fail_next = 0
+        self._truncate = 0
         self.requests_served = 0
 
         server = self
@@ -59,20 +66,19 @@ class FakeApiServer:
                         self._reply(500, {"error": "injected"})
                         return
                     url = urlparse(self.path)
-                    selector = parse_qs(url.query).get(
-                        "labelSelector", [""]
-                    )[0]
+                    query = parse_qs(url.query)
+                    selector = query.get("labelSelector", [""])[0]
                     if url.path == "/api/v1/nodes":
                         items = server._select(
                             server.nodes.values(), selector
                         )
-                        self._reply(200, {"items": items})
+                        self._reply(200, server._page(items, query))
                     elif url.path == "/api/v1/pods":
                         server._apply_pending()
                         items = server._select(
                             server.pods.values(), selector
                         )
-                        self._reply(200, {"items": items})
+                        self._reply(200, server._page(items, query))
                     else:
                         self._reply(404, {"error": self.path})
 
@@ -146,6 +152,21 @@ class FakeApiServer:
                 )
             ]
         return out
+
+    def _page(self, items: list[dict], query: dict) -> dict:
+        """Apply truncation fault, then limit/continue chunking. The
+        continue token is the plain offset (opaque to clients anyway)."""
+        if self._truncate > 0:
+            items = items[: self._truncate]
+        offset = int(query.get("continue", ["0"])[0] or 0)
+        limit = int(query.get("limit", ["0"])[0] or 0)
+        if limit <= 0:
+            return {"items": items[offset:]}
+        chunk = items[offset: offset + limit]
+        doc: dict = {"items": chunk, "metadata": {}}
+        if offset + limit < len(items):
+            doc["metadata"]["continue"] = str(offset + limit)
+        return doc
 
     def _apply_pending(self) -> None:
         """Bindings become observable on the next pods poll."""
@@ -221,6 +242,12 @@ class FakeApiServer:
     def fail_next(self, n: int) -> None:
         with self._lock:
             self._fail_next = n
+
+    def truncate_lists(self, n: int) -> None:
+        """Serve only the first n items of every list, with no continue
+        token (0 restores full lists)."""
+        with self._lock:
+            self._truncate = n
 
     def succeed_pod(self, name: str) -> None:
         with self._lock:

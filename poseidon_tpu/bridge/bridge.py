@@ -26,6 +26,14 @@ Deliberate fixes over the reference's semantics:
   round leaves unscheduled, feeding the Quincy/CoCo unscheduled-cost
   terms so parked pods eventually win a slot (the aging input the
   round-2 advisor found dead, ADVICE.md item 4).
+- **Mass-eviction guard.** A poll whose snapshot would remove more than
+  half of the known nodes or pods is held (upserts still apply, the
+  disappearances don't) until the shrink persists for
+  ``SHRINK_STRIKES`` consecutive polls. A truncated list response —
+  an apiserver bug, a dropped page, a mid-rollover partial cache —
+  otherwise reads as mass deletion and wipes scheduler state in one
+  tick. The reference trusts every snapshot blindly
+  (k8s_api_client.cc:100-160).
 """
 
 from __future__ import annotations
@@ -48,6 +56,11 @@ from poseidon_tpu.ops.resident import ResidentSolver
 from poseidon_tpu.trace import TraceGenerator
 
 log = logging.getLogger(__name__)
+
+# Mass-eviction guard: hold a >50% disappearance (of at least
+# SHRINK_MIN_KNOWN known entities) unless it repeats this many polls.
+SHRINK_STRIKES = 3
+SHRINK_MIN_KNOWN = 8
 
 
 @dataclasses.dataclass
@@ -108,11 +121,43 @@ class SchedulerBridge:
             collections.deque(maxlen=100_000)
         )
         self._evictions_this_round = 0
+        # consecutive implausible-shrink polls (mass-eviction guard)
+        self._node_shrink_strikes = 0
+        self._pod_shrink_strikes = 0
+
+    def _hold_shrink(self, counter: str, kind: str, known: int,
+                     gone: int) -> bool:
+        """Mass-eviction guard: True = hold this poll's disappearances.
+
+        ``known`` is the entity count BEFORE the poll's upserts — a
+        truncated snapshot that also carries new names must not inflate
+        the denominator and slip past the threshold.
+        """
+        if known < SHRINK_MIN_KNOWN or gone * 2 <= known:
+            setattr(self, counter, 0)
+            return False
+        strikes = getattr(self, counter) + 1
+        setattr(self, counter, strikes)
+        if strikes < SHRINK_STRIKES:
+            log.warning(
+                "%s snapshot lost %d of %d known; holding (strike "
+                "%d/%d) — truncated list response?",
+                kind, gone, known, strikes, SHRINK_STRIKES,
+            )
+            return True
+        log.warning(
+            "%s shrink persisted %d polls; accepting it as real",
+            kind, strikes,
+        )
+        setattr(self, counter, 0)
+        return False
 
     # ---- observation (the poll side) -----------------------------------
 
     def observe_nodes(self, nodes: list[Machine]) -> None:
         """Upsert machines; release the ones that disappeared."""
+        known_before = len(self.machines)
+        known_names = set(self.machines)
         seen = set()
         for node in nodes:
             if node.max_tasks <= 0:
@@ -134,7 +179,11 @@ class SchedulerBridge:
                     ),
                 ),
             )
-        gone = set(self.machines) - seen
+        gone = known_names - seen
+        if self._hold_shrink(
+            "_node_shrink_strikes", "node", known_before, len(gone)
+        ):
+            return
         for name in gone:
             log.warning("node %s removed; evicting its tasks", name)
             del self.machines[name]
@@ -152,6 +201,8 @@ class SchedulerBridge:
     def observe_pods(self, pods: list[Task]) -> None:
         """The reference's per-pod dispatch (scheduler_bridge.cc:132-162),
         with restart reconcile and terminal-state retirement."""
+        known_before = len(self.tasks)
+        known_uids = set(self.tasks)
         seen = set()
         for pod in pods:
             seen.add(pod.uid)
@@ -222,7 +273,11 @@ class SchedulerBridge:
                     self.tasks.pop(pod.uid, None)
                     self.pod_to_machine.pop(pod.uid, None)
                     self.knowledge.retire_task(pod.uid)
-        gone = set(self.tasks) - seen
+        gone = known_uids - seen
+        if self._hold_shrink(
+            "_pod_shrink_strikes", "pod", known_before, len(gone)
+        ):
+            return
         for uid in gone:
             self.tasks.pop(uid, None)
             self.pod_to_machine.pop(uid, None)

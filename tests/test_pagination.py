@@ -1,0 +1,179 @@
+"""List pagination + the bridge's mass-eviction guard.
+
+The reference does one unpaginated GET per resource and trusts whatever
+came back (k8s_api_client.cc:100-160). Against an apiserver that chunks
+its lists (``limit``/``continue``) that drops every item after page one,
+and a truncated response reads as mass deletion — one bad poll would
+evict most of the scheduler's state. These tests pin both defenses:
+
+- the client follows ``metadata.continue`` tokens until the list is
+  complete (round-3 verdict, Next #7);
+- the bridge holds a >50% disappearance for ``SHRINK_STRIKES``
+  consecutive polls before honoring it, and still honors a persistent
+  (real) shrink afterwards.
+"""
+
+from __future__ import annotations
+
+from poseidon_tpu.apiclient import FakeApiServer, K8sApiClient
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.bridge.bridge import SHRINK_STRIKES
+from poseidon_tpu.cluster import TaskPhase
+
+
+def _fill(server: FakeApiServer, nodes: int, pods: int) -> None:
+    for i in range(nodes):
+        server.add_node(f"node-{i:03d}", rack=f"rack-{i % 3}")
+    for i in range(pods):
+        server.add_pod(f"pod-{i:03d}")
+
+
+class TestClientPagination:
+    def test_follows_continue_tokens(self):
+        with FakeApiServer() as server:
+            _fill(server, nodes=23, pods=57)
+            client = K8sApiClient(port=server.port, page_limit=10)
+            nodes = client.all_nodes()
+            pods = client.all_pods()
+        assert sorted(n.name for n in nodes) == sorted(
+            f"node-{i:03d}" for i in range(23)
+        )
+        assert sorted(p.uid for p in pods) == sorted(
+            f"pod-{i:03d}" for i in range(57)
+        )
+
+    def test_single_page_when_under_limit(self):
+        with FakeApiServer() as server:
+            _fill(server, nodes=3, pods=4)
+            client = K8sApiClient(port=server.port, page_limit=500)
+            before = server.requests_served
+            assert len(client.all_nodes()) == 3
+            assert server.requests_served == before + 1
+
+    def test_selector_applies_across_pages(self):
+        with FakeApiServer() as server:
+            _fill(server, nodes=12, pods=0)
+            client = K8sApiClient(port=server.port, page_limit=5)
+            rack0 = client.nodes_with_label("rack=rack-0")
+        assert sorted(n.name for n in rack0) == sorted(
+            f"node-{i:03d}" for i in range(12) if i % 3 == 0
+        )
+
+
+class TestMassEvictionGuard:
+    def _observe(self, bridge, client):
+        bridge.observe_nodes(client.all_nodes())
+        bridge.observe_pods(client.all_pods())
+
+    def test_truncated_snapshot_does_not_evict(self):
+        with FakeApiServer() as server:
+            _fill(server, nodes=10, pods=40)
+            client = K8sApiClient(port=server.port)
+            bridge = SchedulerBridge()
+            self._observe(bridge, client)
+            assert len(bridge.machines) == 10
+            assert len(bridge.tasks) == 40
+
+            # one faulty poll: only 2 nodes / 5 pods come back, with no
+            # continue token — a partial snapshot masquerading as full
+            server.truncate_lists(2)
+            bridge.observe_nodes(client.all_nodes())
+            server.truncate_lists(5)
+            bridge.observe_pods(client.all_pods())
+            assert len(bridge.machines) == 10, "held, not evicted"
+            assert len(bridge.tasks) == 40, "held, not retired"
+
+            # recovery: the next full poll clears the strike counters
+            server.truncate_lists(0)
+            self._observe(bridge, client)
+            assert len(bridge.machines) == 10
+            assert len(bridge.tasks) == 40
+            assert bridge._node_shrink_strikes == 0
+            assert bridge._pod_shrink_strikes == 0
+
+    def test_persistent_shrink_is_honored(self):
+        with FakeApiServer() as server:
+            _fill(server, nodes=10, pods=40)
+            client = K8sApiClient(port=server.port)
+            bridge = SchedulerBridge()
+            self._observe(bridge, client)
+
+            # a real teardown: most pods deleted, most nodes drained
+            for i in range(3, 10):
+                server.drop_node(f"node-{i:03d}")
+            with server._lock:
+                for i in range(10, 40):
+                    server.pods.pop(f"pod-{i:03d}", None)
+
+            for _ in range(SHRINK_STRIKES - 1):
+                self._observe(bridge, client)
+                assert len(bridge.machines) == 10  # still holding
+                assert len(bridge.tasks) == 40
+            self._observe(bridge, client)  # strike limit reached
+            assert len(bridge.machines) == 3
+            assert len(bridge.tasks) == 10
+
+    def test_truncated_snapshot_with_new_names_still_held(self):
+        # the guard's denominator is the PRE-upsert known count: a
+        # truncated poll that also carries new names must not inflate
+        # it past the >50% threshold (mid-rollover partial cache)
+        with FakeApiServer() as server:
+            _fill(server, nodes=10, pods=0)
+            client = K8sApiClient(port=server.port)
+            bridge = SchedulerBridge()
+            bridge.observe_nodes(client.all_nodes())
+            assert len(bridge.machines) == 10
+            # 4 survivors + 3 brand-new nodes; 6 of 10 known vanish
+            with server._lock:
+                survivors = {f"node-{i:03d}" for i in range(4)}
+                for name in list(server.nodes):
+                    if name not in survivors:
+                        del server.nodes[name]
+            for i in range(3):
+                server.add_node(f"fresh-{i}")
+            bridge.observe_nodes(client.all_nodes())
+            # held: the 6 missing stay known, the 3 new are upserted
+            assert len(bridge.machines) == 13
+            assert bridge._node_shrink_strikes == 1
+
+    def test_small_clusters_evict_immediately(self):
+        # the guard only arms at SHRINK_MIN_KNOWN entities: a 3-node dev
+        # cluster dropping 2 nodes is ordinary, not implausible
+        with FakeApiServer() as server:
+            _fill(server, nodes=3, pods=4)
+            client = K8sApiClient(port=server.port)
+            bridge = SchedulerBridge()
+            self._observe(bridge, client)
+            server.drop_node("node-001")
+            server.drop_node("node-002")
+            self._observe(bridge, client)
+            assert set(bridge.machines) == {"node-000"}
+
+    def test_plausible_shrink_unaffected(self):
+        with FakeApiServer() as server:
+            _fill(server, nodes=10, pods=40)
+            client = K8sApiClient(port=server.port)
+            bridge = SchedulerBridge()
+            self._observe(bridge, client)
+            server.drop_node("node-009")
+            with server._lock:
+                for i in range(35, 40):
+                    server.pods.pop(f"pod-{i:03d}", None)
+            self._observe(bridge, client)
+            assert len(bridge.machines) == 9
+            assert len(bridge.tasks) == 35
+
+    def test_held_pods_keep_phase(self):
+        # a held pod snapshot must not corrupt task phases: pods absent
+        # from the truncated list keep their recorded state
+        with FakeApiServer() as server:
+            _fill(server, nodes=10, pods=40)
+            client = K8sApiClient(port=server.port)
+            bridge = SchedulerBridge()
+            self._observe(bridge, client)
+            server.truncate_lists(5)
+            bridge.observe_pods(client.all_pods())
+            assert all(
+                t.phase == TaskPhase.PENDING
+                for t in bridge.tasks.values()
+            )

@@ -20,19 +20,24 @@ transportation problem, Jacobi (all-bidders-at-once) rounds, with one
 price per machine *class* (slots of a machine are interchangeable, so
 the LP dual has one multiplier per machine — not per slot):
 
-- state is just ``asg[T]`` (machine / UNSCHED / -1) and ``lvl[T]`` (the
-  price each holder committed); machine prices are DERIVED: p[m] = the
-  weakest holder's level if m is full, else 0. A machine with free
-  capacity therefore always asks 0 — the "stranded price on an empty
-  slot" failure mode of slot-priced auctions cannot be represented.
-- each round, every unassigned task computes its best and second-best
-  option over {all machines, unsched} at current prices and bids
-  ``b2 + eps - c[t, m*]`` on its best machine (so it tolerates paying up
-  to eps more than its runner-up). Holders and bids then meet in ONE
-  lexicographic sort by (machine, -level, task): the top ``slots[m]``
-  entries per machine hold, everyone else is released. A rejected bid
-  means the machine's derived price rose by >= eps, so rounds make
-  strict dual progress; prices only rise within a phase, which preserves
+- the loop carries the MACHINE-SORTED seat layout ``(sm, slvl, st)``
+  (positions lexicographically sorted by segment, -level, task id; the
+  per-task ``asg``/``lvl`` view exists only at phase boundaries and at
+  the end). Machine prices are DERIVED from the layout: p[m] = the
+  weakest seated holder's level if m is full, else its reserve floor.
+- each round, the unassigned tasks (compacted into a bid window of at
+  most Tp/4) compute their best and second-best option over {all
+  machines, unsched} at current prices and bid ``b2 + eps - c[t, m*]``
+  on their best machine (so a bidder tolerates paying up to eps more
+  than its runner-up). Ties for the best machine break by a per-task
+  rotation, not lowest-index — tied cost tiers otherwise herd every
+  bidder onto one machine and serialize seating behind an eps price
+  crawl (measured: 478 -> 23 rounds on the CoCo config). Holders and
+  bids then meet in ONE lexicographic re-sort by (machine, -level,
+  holder-first, task): the top ``slots[m]`` positions per segment hold,
+  everyone else re-enters the wait pool. A rejected bid means the
+  machine's derived price rose by >= eps, so rounds make strict dual
+  progress; prices only rise within a phase, which preserves
   eps-complementary-slackness for every standing assignment.
 - phases shrink eps by ``alpha``; each phase boundary releases the
   assignments that violate the tighter eps and re-runs. Costs are
@@ -397,10 +402,77 @@ def _solve(
     smax: int,
     analytic_init: bool = False,
 ):
+    """Core loop. The carry is the MACHINE-SORTED seat layout
+    ``(sm, slvl, st)`` — positions sorted by (segment, -level, task) —
+    not per-task arrays:
+
+    - segment boundaries come from one ``searchsorted`` over the sorted
+      keys, so the per-round ask prices need no scatter-based segment
+      ops (measured 0.9 ms/round of segment_min+scatter at 16k tasks);
+    - seat membership is just ``rank < s[m]`` inside each segment, so
+      the end-of-round writeback is the re-sort itself — no scatters;
+    - only the (few) unassigned tasks bid each round, compacted into a
+      ``[B, Mp]`` window (B = Tp/4, min 1024) instead of the full
+      ``[Tp, Mp]`` option pass (measured 0.6 ms/round at 16k x 1k; the
+      average live bidder count at the flagship is ~100-700).
+
+    Dense [Tp, Mp] passes and task-space scatters survive only at phase
+    boundaries (violator release / reserve deflation), which run
+    O(phases) times, not O(rounds).
+    """
     Tp, Mp = dev.c.shape
-    UNS = Mp           # asg code for unscheduled
-    DUMP = Mp + 1      # sort segment for non-participants
+    UNS = Mp           # segment for unscheduled tasks
+    WAIT = Mp + 1      # segment for unassigned tasks awaiting a bid slot
+    DUMP = Mp + 2      # segment for non-participants (padding tasks)
+    NSEG = Mp + 3
+    B = min(Tp, max(1024, Tp // 4))   # bid-window width (static)
     tids = jnp.arange(Tp, dtype=I32)
+    pos = jnp.arange(Tp, dtype=I32)
+
+    def to_sorted(asg, lvl):
+        """Task-space (asg, lvl) -> sorted carry (releases inside the
+        loop go through phase_shift's position-space ``release``)."""
+        on_m = (asg >= 0) & (asg < Mp)
+        km = jnp.where(
+            on_m, asg,
+            jnp.where(asg == UNS, UNS,
+                      jnp.where(dev.task_valid, WAIT, DUMP)),
+        ).astype(I32)
+        kl = jnp.where(on_m & (km < Mp), lvl, 0)
+        sm, snl, st = jax.lax.sort((km, -kl, tids), num_keys=3)
+        return sm, -snl, st
+
+    def layout(sm):
+        """Segment geometry of a sorted carry: boundaries, per-machine
+        fullness/occupancy, per-position seat membership."""
+        bnd = jnp.searchsorted(sm, jnp.arange(NSEG + 1, dtype=I32))
+        segsz = bnd[1 : Mp + 1] - bnd[:Mp]
+        occ = jnp.minimum(segsz, dev.s)
+        full = segsz >= dev.s
+        rank = pos - bnd[jnp.minimum(sm, NSEG - 1)]
+        in_m = sm < Mp
+        seated = in_m & (rank < dev.s[jnp.minimum(sm, Mp - 1)])
+        waiting = (in_m & ~seated) | (sm == WAIT)
+        return bnd, occ, full, seated, waiting
+
+    def to_task(sm, slvl, st, seated):
+        """Sorted carry -> task-space (asg, lvl); boundary-only."""
+        val = jnp.where(
+            seated, sm,
+            jnp.where(sm == UNS, UNS, jnp.where(sm == DUMP, UNS, -1)),
+        )
+        asg = jnp.zeros(Tp, I32).at[st].set(val)
+        lvl = jnp.zeros(Tp, I32).at[st].set(jnp.where(seated, slvl, 0))
+        return asg, lvl
+
+    def ask_from_layout(slvl, bnd, occ, full, floor):
+        """Machine ask prices from the sorted layout: the weakest SEATED
+        holder sits at the end of the seated prefix of its segment
+        (levels are sorted descending within a segment)."""
+        last = jnp.clip(bnd[:Mp] + occ - 1, 0, Tp - 1)
+        minlvl = jnp.where(occ > 0, slvl[last], INF)
+        p = jnp.where(full, jnp.minimum(minlvl, INF), floor)
+        return jnp.where(dev.s > 0, p, INF)
 
     if analytic_init:
         asg0, lvl0, lam0, _theta = _theta_clearing(dev)
@@ -422,58 +494,85 @@ def _solve(
         gain = jnp.where(dev.task_valid, jnp.maximum(gen0 - v0, 0), 0)
         eps0 = jnp.maximum(jnp.max(gain), 1).astype(I32)
 
-    def auction_round(asg, lvl, floor, eps):
-        p, _full = _ask_prices(dev, asg, lvl, floor)
-        b1v, m1, v2 = _task_options(dev, p)
-        unassigned = (asg < 0) & dev.task_valid
-        take_uns = unassigned & (dev.u <= b1v)
-        asg = jnp.where(take_uns, UNS, asg)
-        lvl = jnp.where(take_uns, 0, lvl)
+    def auction_round(sm, slvl, st, floor, eps, lay):
+        """One Jacobi bidding round entirely in the sorted layout."""
+        bnd, occ, full, seated, waiting = lay
+        p = ask_from_layout(slvl, bnd, occ, full, floor)
 
-        bidder = unassigned & ~take_uns
-        b2 = jnp.minimum(v2, dev.u)
-        c1 = jnp.take_along_axis(dev.c, m1[:, None], axis=1)[:, 0]
+        # compact the (few) unassigned tasks into the bid window; any
+        # overflow simply waits — it re-enters via the WAIT segment.
+        # Compaction by sort, not jnp.nonzero: nonzero lowers to a
+        # prefix-scan (reduce-window) whose scoped-VMEM footprint blew
+        # the 16 MB limit at 12k-machine shapes and under vmap.
+        bpos = jax.lax.sort(jnp.where(waiting, pos, Tp))[:B]
+        bvalid = bpos < Tp
+        bpos_safe = jnp.minimum(bpos, Tp - 1)
+        btask = st[bpos_safe]
+        cb = dev.c[btask]                       # [B, Mp] gather
+        vb = jnp.minimum(cb + p[None, :], INF)
+        b1v = jnp.min(vb, axis=1)
+        # rotated tie-break: any machine achieving b1v is a legal best
+        # choice, but argmin's lowest-index rule herds every tied
+        # bidder onto the SAME machine — s_m win, the rest re-bid after
+        # an eps price crawl, one machine at a time (measured: CoCo's
+        # tied tiers spent ~66 rounds/phase re-seating the same ~1.3k
+        # tasks). A per-task rotation spreads tied bidders uniformly
+        # across their whole tie set in one round.
+        midx = jnp.arange(Mp, dtype=I32)[None, :]
+        # 40503 = Knuth's 16-bit hash multiplier; product stays in i32
+        rot = (btask * 40503 % Mp).astype(I32)[:, None]
+        tie_rank = (midx - rot) % Mp
+        m1 = jnp.argmin(
+            jnp.where(vb == b1v[:, None], tie_rank, Mp + 1), axis=1
+        ).astype(I32)
+        masked = jnp.where(midx == m1[:, None], INF, vb)
+        v2 = jnp.min(masked, axis=1)
+        ub = dev.u[btask]
+        take_uns = bvalid & (ub <= b1v)
+        bids = bvalid & ~take_uns
+        b2 = jnp.minimum(v2, ub)
+        c1 = jnp.take_along_axis(cb, m1[:, None], axis=1)[:, 0]
         beta = jnp.minimum(
             b2.astype(jnp.int64) + eps - c1, jnp.int64(INF - 1)
         ).astype(I32)
 
-        on_machine = (asg >= 0) & (asg < Mp)
-        key_m = jnp.where(
-            on_machine,
-            asg,
-            jnp.where(asg == UNS, UNS, jnp.where(bidder, m1, DUMP)),
+        # new keys per position: holders keep their seats, everyone
+        # else parks in WAIT unless this window gave them a bid
+        new_km = jnp.where(
+            seated, sm,
+            jnp.where(sm == UNS, UNS, jnp.where(sm == DUMP, DUMP, WAIT)),
         )
-        key_lvl = jnp.where(on_machine, lvl, jnp.where(bidder, beta, 0))
+        new_kl = jnp.where(seated, slvl, 0)
+        upd_km = jnp.where(take_uns, UNS, jnp.where(bids, m1, WAIT))
+        upd_kl = jnp.where(bids, beta, 0)
+        # out-of-range fill positions (Tp) drop out of the scatter
+        new_km = new_km.at[bpos].set(upd_km, mode="drop")
+        new_kl = new_kl.at[bpos].set(upd_kl, mode="drop")
         # holders outrank bidders at equal level: a bid that merely TIES
         # a holder must not displace it (tid-order displacement at equal
         # level is a zero-progress carousel — the displaced holder hops
         # on at the same level forever); with holders-first ties every
         # successful displacement strictly raises the machine's floor
-        is_bid = jnp.where(on_machine, 0, 1).astype(I32)
-        sm, snl, _sb, st = jax.lax.sort(
-            (key_m, -key_lvl, is_bid, tids), num_keys=4
+        is_bid = (
+            jnp.zeros(Tp, I32)
+            .at[bpos]
+            .set(jnp.where(bids, 1, 0), mode="drop")
         )
-        # rank of each sorted entry within its machine segment
-        first = jax.ops.segment_min(
-            jnp.arange(Tp, dtype=I32), sm, num_segments=Mp + 2
+        sm2, snl2, _isb, st2 = jax.lax.sort(
+            (new_km, -new_kl, is_bid, st), num_keys=4
         )
-        rank = jnp.arange(Tp, dtype=I32) - first[sm]
-        seat = (sm < Mp) & (rank < dev.s[jnp.minimum(sm, Mp - 1)])
-        new_asg = jnp.where(seat, sm, jnp.where(sm == UNS, UNS, -1))
-        new_lvl = jnp.where(seat, -snl, 0)
-        asg = asg.at[st].set(new_asg)
-        lvl = lvl.at[st].set(new_lvl)
-        return asg, lvl
+        return sm2, -snl2, st2
 
-    def violators(asg, lvl, floor, eps):
+    def violators(asg, p, eps):
         """Standing assignments whose value at the ASK prices is more
         than eps worse than the task's best option. The ask price (min
         holder level when full, reserve floor otherwise) is what enters
         both the primal-dual gap and the eps-CS invariant — a holder's
         own committed level does not (the primal pays c[t, m], not lvl),
         so comparing against lvl would release tasks that merely out-bid
-        their seat-mates and cycle forever."""
-        p, _full = _ask_prices(dev, asg, lvl, floor)
+        their seat-mates and cycle forever. ``p`` comes straight from
+        the sorted layout (ask_from_layout) — recomputing it from task
+        space cost three scatter-class ops per boundary step."""
         b1v, _, _ = _task_options(dev, p)
         b1 = jnp.minimum(b1v, dev.u)
         on_machine = (asg >= 0) & (asg < Mp)
@@ -491,7 +590,7 @@ def _solve(
         )
         return dev.task_valid & (asg >= 0) & (cur > b1 + eps)
 
-    def deflate(asg, lvl, floor, eps):
+    def deflate(p, full, floor, eps):
         """Reverse-auction step for FREE machines only.
 
         Holder levels are never deflated: a full machine's ask is
@@ -510,7 +609,6 @@ def _solve(
         indifference band, so the machine provably either fills or
         keeps falling (at exactly clearing - eps the STRICT violator
         test never fires and the reserve would sit stranded forever)."""
-        p, full = _ask_prices(dev, asg, lvl, floor)
         b1v, m1, v2, v = _task_options(dev, p, with_values=True)
         alt1 = jnp.minimum(b1v, dev.u)
         alt2 = jnp.minimum(v2, dev.u)
@@ -527,37 +625,58 @@ def _solve(
             jnp.where(full, jnp.minimum(floor, p), floor),
             jnp.clip(clear - eps - 1, 0, INF),
         )
-        return lvl, floor
+        return floor
 
     def body(carry):
-        asg, lvl, floor, eps, rounds, phases, done, hist = carry
-        any_unassigned = jnp.any((asg < 0) & dev.task_valid)
+        sm, slvl, st, floor, eps, rounds, phases, done, hist = carry
+        lay = layout(sm)
+        _bnd, _occ, _full, seated, waiting = lay
+        any_unassigned = jnp.any(waiting)
 
         def run_round(_):
-            a, l = auction_round(asg, lvl, floor, eps)
+            sm2, slvl2, st2 = auction_round(sm, slvl, st, floor, eps, lay)
             h = hist.at[jnp.minimum(phases, 31)].add(1)
             h = h.at[jnp.minimum(phases, 31) + 96].add(
-                jnp.sum((asg < 0) & dev.task_valid, dtype=I32)
+                jnp.sum(waiting, dtype=I32)
             )
-            return a, l, floor, eps, rounds + 1, phases, done, h
+            return sm2, slvl2, st2, floor, eps, rounds + 1, phases, done, h
 
         def phase_shift(_):
+            bnd, occ, full, _seated, _waiting = lay
+            # task-space asg for the violator check (one scatter); the
+            # re-sorted carry is rebuilt from POSITION-space releases,
+            # so holder levels never round-trip through task space
+            val = jnp.where(
+                seated, sm, jnp.where(sm >= UNS, UNS, -1)
+            )
+            asg = jnp.zeros(Tp, I32).at[st].set(val)
+
+            def release(viol):
+                """Re-sort the carry with violators (a [T] task-space
+                mask) sent to WAIT."""
+                viol_pos = viol[st]
+                km = jnp.where(viol_pos, WAIT, sm)
+                kl = jnp.where(viol_pos, 0, slvl)
+                s2, nl2, t2 = jax.lax.sort((km, -kl, st), num_keys=3)
+                return s2, -nl2, t2
+
             # everyone is assigned — but a phase is only COMPLETE when
             # the state is stable at the CURRENT eps. Tightening eps on
             # a transient all-assigned state leaves contested-machine
             # price discovery unresolved and pushes it to the finest
             # phases, where it crawls at eps per round (measured: an
             # 11-task pref fight cost 11k rounds at eps=4 this way).
-            viol_now = violators(asg, lvl, floor, eps)
+            p_now = ask_from_layout(slvl, bnd, occ, full, floor)
+            viol_now = violators(asg, p_now, eps)
             any_now = jnp.any(viol_now)
 
             def refight(_):
-                a = jnp.where(viol_now, -1, asg)
-                l = jnp.where(viol_now, 0, lvl)
+                sm2, slvl2, st2 = release(viol_now)
                 h = hist.at[jnp.minimum(phases, 31) + 32].add(
                     jnp.sum(viol_now, dtype=I32)
                 )
-                return (a, l, floor, eps, rounds + 1, phases, done, h)
+                return (sm2, slvl2, st2, floor, eps, rounds + 1,
+                        phases, done, h)
 
             def tighten(_):
                 # stable at eps: deflate free-machine reserves, shrink
@@ -570,66 +689,56 @@ def _solve(
                 next_eps = jnp.maximum(1, eps // alpha)
                 at_floor = eps <= 1
                 eps_chk = jnp.where(at_floor, eps, next_eps)
-                l0, f0 = deflate(asg, lvl, floor, eps_chk)
-                viol = violators(asg, l0, f0, eps_chk)
+                f0 = deflate(p_now, full, floor, eps_chk)
+                p0 = ask_from_layout(slvl, bnd, occ, full, f0)
+                viol = violators(asg, p0, eps_chk)
                 any_viol = jnp.any(viol)
-                _p, full = _ask_prices(dev, asg, l0, f0)
                 stranded = ~full & (dev.s > 0) & (f0 > 0)
                 force = at_floor & ~any_viol & jnp.any(stranded)
                 f1 = jnp.where(force & stranded, 0, f0)
                 viol2 = jax.lax.cond(
                     force,
-                    lambda _: violators(asg, l0, f1, eps_chk),
+                    lambda _: violators(
+                        asg,
+                        ask_from_layout(slvl, bnd, occ, full, f1),
+                        eps_chk,
+                    ),
                     lambda _: viol,
                     None,
                 )
                 any_viol2 = jnp.any(viol2)
-                a = jnp.where(viol2, -1, asg)
-                l = jnp.where(viol2, 0, l0)
+                sm2, slvl2, st2 = release(viol2)
                 new_done = at_floor & ~any_viol2 & ~jnp.any(
                     ~full & (dev.s > 0) & (f1 > 0)
                 )
                 h = hist.at[jnp.minimum(phases, 31) + 64].add(
                     jnp.sum(viol2, dtype=I32)
                 )
-                return (a, l, f1, next_eps, rounds + 1, phases + 1,
-                        new_done, h)
+                return (sm2, slvl2, st2, f1, next_eps, rounds + 1,
+                        phases + 1, new_done, h)
 
             return jax.lax.cond(any_now, refight, tighten, None)
 
         return jax.lax.cond(any_unassigned, run_round, phase_shift, None)
 
-    if not analytic_init:
-        # a warm state may carry more holders on a machine than its
-        # (possibly shrunk) capacity allows; auction_round's seat trim
-        # only runs while someone is unassigned, and the certificate
-        # does not check capacity — so trim before the loop. The trim
-        # is auction_round's holder ranking with no bidders: sort
-        # holders by (machine, -level, tid), keep the top s_m, release
-        # the rest (they re-bid in the first rounds).
-        on_m0 = (asg0 >= 0) & (asg0 < Mp)
-        km = jnp.where(on_m0, asg0, jnp.where(asg0 == UNS, UNS, DUMP))
-        kl = jnp.where(on_m0, lvl0, 0)
-        sm0, _snl0, st0 = jax.lax.sort((km, -kl, tids), num_keys=3)
-        first0 = jax.ops.segment_min(
-            jnp.arange(Tp, dtype=I32), sm0, num_segments=Mp + 2
-        )
-        rank0 = jnp.arange(Tp, dtype=I32) - first0[sm0]
-        keep = (sm0 >= Mp) | (rank0 < dev.s[jnp.minimum(sm0, Mp - 1)])
-        dropped = jnp.zeros(Tp, bool).at[st0].set(~keep)
-        asg0 = jnp.where(dropped, -1, asg0)
-        lvl0 = jnp.where(dropped, 0, lvl0)
+    # a warm state may carry more holders on a machine than its
+    # (possibly shrunk) capacity allows; the sorted layout trims this
+    # naturally — overflow holders land at rank >= s_m, read as waiting,
+    # and re-bid in the first rounds.
+    sm0, slvl0, st0 = to_sorted(asg0, lvl0)
 
     def cond(carry):
-        rounds, done = carry[4], carry[6]
+        rounds, done = carry[5], carry[7]
         return ~done & (rounds < max_rounds)
 
-    (asg, lvl, floor, eps, rounds, phases, done,
+    (sm, slvl, st, floor, eps, rounds, phases, done,
      hist) = jax.lax.while_loop(
         cond, body,
-        (asg0, lvl0, floor0, eps0.astype(I32), jnp.int32(0),
+        (sm0, slvl0, st0, floor0, eps0.astype(I32), jnp.int32(0),
          jnp.int32(0), jnp.bool_(False), jnp.zeros(128, I32)),
     )
+    _bnd, _occ, _full, seated_f, _waiting = layout(sm)
+    asg, lvl = to_task(sm, slvl, st, seated_f)
 
     # exactness certificate: primal - dual at the ask prices, with
     # lam = 0 on every non-full machine (complementary slackness)
@@ -654,7 +763,7 @@ def _solve(
     return asg, lvl, floor, gap, converged, rounds, phases, hist
 
 
-def cold_start(inst_dev: DenseInstance, alpha: int = 4):
+def cold_start(inst_dev: DenseInstance, alpha: int = 1024):
     """Canonical cold-start state: (asg0, lvl0, floor0, eps0)."""
     Tp, Mp = inst_dev.c.shape
     asg0 = jnp.where(inst_dev.task_valid, -1, Mp).astype(I32)
@@ -664,11 +773,40 @@ def cold_start(inst_dev: DenseInstance, alpha: int = 4):
     return asg0, lvl0, floor0, eps0
 
 
+@partial(jax.jit, static_argnames=("alpha", "max_rounds", "smax"))
+def _solve_warm(dev: DenseInstance, asg0, lvl0, floor0, alpha: int,
+                max_rounds: int, smax: int):
+    """Warm entry: re-settle a carried state at eps = 1 (the constant
+    materializes inside the jit region — no per-call host dispatch)."""
+    return _solve(
+        dev, asg0, lvl0, floor0, jnp.int32(1), alpha=alpha,
+        max_rounds=max_rounds, smax=smax, analytic_init=False,
+    )
+
+
+@partial(jax.jit, static_argnames=("alpha", "max_rounds", "smax"))
+def _solve_cold(dev: DenseInstance, alpha: int, max_rounds: int,
+                smax: int):
+    """Cold entry: the placeholder start state materializes INSIDE the
+    jit region. Building it eagerly (cold_start) cost four host
+    dispatches per solve — more than the whole solve on small
+    instances under this environment's ~3 ms-per-dispatch tunnel."""
+    Tp, Mp = dev.c.shape
+    asg0 = jnp.where(dev.task_valid, -1, Mp).astype(I32)
+    lvl0 = jnp.zeros(Tp, I32)
+    floor0 = jnp.zeros(Mp, I32)
+    eps0 = jnp.maximum(dev.cmax // alpha, 1)
+    return _solve(
+        dev, asg0, lvl0, floor0, eps0, alpha=alpha,
+        max_rounds=max_rounds, smax=smax, analytic_init=True,
+    )
+
+
 def solve_dense(
     inst_dev: DenseInstance,
     *,
     warm: DenseState | None = None,
-    alpha: int = 4,
+    alpha: int = 1024,
     max_rounds: int = 20_000,
 ) -> DenseState:
     """Run the auction on device; returns device-resident state.
@@ -686,21 +824,21 @@ def solve_dense(
         warm.asg.shape[0] != Tp or warm.floor.shape[0] != Mp
     ):
         warm = None  # cluster outgrew its padding bucket: cold solve
-    analytic = warm is None
-    if analytic:
-        # placeholders; the kernel's analytic clearing start replaces
-        # them (keeping one compiled program for the cold path)
-        asg0, lvl0, floor0, eps0 = cold_start(inst_dev, alpha)
-    else:
-        asg0 = warm.asg
-        lvl0 = warm.lvl
-        floor0 = warm.floor
-        eps0 = jnp.int32(1)
     with jax.enable_x64(True):
-        asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
-            inst_dev, asg0, lvl0, floor0, eps0, alpha=alpha,
-            max_rounds=max_rounds, smax=smax, analytic_init=analytic,
-        )
+        if warm is None:
+            asg, lvl, floor, gap, converged, rounds, phases, _ = (
+                _solve_cold(
+                    inst_dev, alpha=alpha, max_rounds=max_rounds,
+                    smax=smax,
+                )
+            )
+        else:
+            asg, lvl, floor, gap, converged, rounds, phases, _ = (
+                _solve_warm(
+                    inst_dev, warm.asg, warm.lvl, warm.floor,
+                    alpha=alpha, max_rounds=max_rounds, smax=smax,
+                )
+            )
     return DenseState(
         asg=asg, lvl=lvl, floor=floor, gap=gap, converged=converged,
         rounds=rounds, phases=phases,
@@ -767,7 +905,7 @@ def solve_transport_dense(
     inst: TransportInstance,
     *,
     warm: DenseState | None = None,
-    alpha: int = 4,
+    alpha: int = 1024,
     max_rounds: int = 20_000,
 ) -> tuple[TransportResult, DenseState]:
     """Host-facing wrapper: densify, solve on device, read back once."""

@@ -261,7 +261,7 @@ class ResidentSolver:
     def __init__(
         self,
         *,
-        alpha: int = 4,
+        alpha: int = 1024,
         max_rounds: int = 20_000,
         oracle_fallback: bool = True,
         oracle_timeout_s: float = 1000.0,

@@ -11,12 +11,14 @@ ICI), and the exactness certificate has an explicit ``shard_map`` +
 
 from poseidon_tpu.parallel.mesh import make_mesh
 from poseidon_tpu.parallel.sharded import (
+    collective_account,
     shard_instance,
     sharded_certificate_gap,
     solve_dense_sharded,
 )
 
 __all__ = [
+    "collective_account",
     "make_mesh",
     "shard_instance",
     "sharded_certificate_gap",

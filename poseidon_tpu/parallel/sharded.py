@@ -27,6 +27,8 @@ aggregates only.
 
 from __future__ import annotations
 
+import re
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -35,7 +37,9 @@ from poseidon_tpu.ops.dense_auction import (
     INF,
     DenseInstance,
     DenseState,
+    cold_start,
     solve_dense,
+    _solve,
 )
 
 
@@ -64,7 +68,7 @@ def solve_dense_sharded(
     sharded: DenseInstance,
     *,
     warm: DenseState | None = None,
-    alpha: int = 4,
+    alpha: int = 1024,
     max_rounds: int = 20_000,
 ) -> DenseState:
     """Solve an instance previously laid out by ``shard_instance``.
@@ -79,6 +83,41 @@ def solve_dense_sharded(
     return solve_dense(
         sharded, warm=warm, alpha=alpha, max_rounds=max_rounds
     )
+
+
+_COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "collective-permute",
+    "reduce-scatter",
+)
+
+
+def collective_account(
+    sharded: DenseInstance, *, alpha: int = 1024,
+    max_rounds: int = 20_000,
+) -> dict[str, int]:
+    """Count the collectives XLA's SPMD partitioner inserted into the
+    compiled sharded solve (optimized-HLO audit, SURVEY §2.4).
+
+    The task axis is sharded and machine aggregates are replicated, so
+    the expected shape is: all-reduces for per-machine price/fullness
+    aggregates and convergence tests, and all-to-alls only where the
+    global lexicographic seat sort crosses shards. The returned counts
+    are per compiled program (the while-loop body's collectives appear
+    once — they run every round at O(M) bytes, never O(T x M))."""
+    asg0, lvl0, floor0, eps0 = cold_start(sharded, alpha)
+    with jax.enable_x64(True):
+        compiled = _solve.lower(
+            sharded, asg0, lvl0, floor0, eps0, alpha,
+            max_rounds, sharded.smax, analytic_init=True,
+        ).compile()
+        txt = compiled.as_text()
+    return {
+        op: len(re.findall(rf"{op}(?:-start)?\(", txt))
+        for op in _COLLECTIVE_OPS
+    }
 
 
 def _gap_kernel(c, u, task_valid, s, asg, lvl, floor, scale, mesh_axis):

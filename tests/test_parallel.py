@@ -19,6 +19,7 @@ from poseidon_tpu.ops.dense_auction import (
 from poseidon_tpu.ops.transport import extract_instance
 from poseidon_tpu.oracle import solve_oracle
 from poseidon_tpu.parallel import (
+    collective_account,
     make_mesh,
     shard_instance,
     sharded_certificate_gap,
@@ -90,6 +91,52 @@ class TestShardedSolve:
         r1, _ = solve_transport_dense(inst)
         r2, _ = solve_transport_dense(inst, warm=state)
         assert r1.cost == r2.cost
+
+
+class TestShardedScale:
+    """Round-3 verdict, Next #9: the 8-device evidence was 16x256 only.
+    This runs a >= 2k-task instance over the full mesh and audits the
+    collectives the SPMD partitioner actually inserted."""
+
+    def test_2k_tasks_sharded_exact_vs_oracle(self, mesh8):
+        from poseidon_tpu.ops.dense_auction import (
+            _channels_for,
+            _objective,
+        )
+        from poseidon_tpu.synth import make_synthetic_cluster
+
+        # representative capacity ratio (random_cluster can draw 10x+
+        # oversubscription, which is the adversarial price-war class
+        # that correctly exhausts the fuse and falls back to the
+        # oracle — covered by the adversarial sweep, not a scale test)
+        cluster = make_synthetic_cluster(
+            128, 2048, seed=11, max_tasks_per_machine=20,
+            prefs_per_task=2,
+        )
+        net, meta = FlowGraphBuilder().build(cluster)
+        from tests.helpers import price as _price
+
+        net = _price(net, meta, "quincy", cluster)
+        inst = extract_instance(net, meta)
+        dev = build_dense_instance(inst)
+        state = solve_dense_sharded(shard_instance(dev, mesh8))
+        assert bool(jax.device_get(state.converged))
+        o = solve_oracle(net, algorithm="cost_scaling")
+        asg = np.asarray(jax.device_get(state.asg))[: inst.n_tasks]
+        asg = np.where(
+            (asg >= 0) & (asg < inst.n_machines), asg, -1
+        ).astype(np.int32)
+        ch = _channels_for(inst, asg)
+        assert _objective(inst, ch, asg) == o.cost
+
+    def test_collective_account_nonempty(self, mesh8):
+        net, inst = _instance(12, n_machines=32, n_tasks=512)
+        dev = build_dense_instance(inst)
+        acct = collective_account(shard_instance(dev, mesh8))
+        # the sharded program must actually communicate: per-machine
+        # aggregates and convergence tests are all-reduces (or fused
+        # into all-gathers); something cross-shard must exist
+        assert sum(acct.values()) > 0, acct
 
 
 class TestWhatIfBatch:

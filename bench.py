@@ -438,18 +438,21 @@ def main() -> int:
         }
     else:
         fallback = next((r for r in rows if not r.get("error")), None)
+        # trace-replay rows carry solve_p50_ms but no warm/oracle fields
+        val = fallback.get(
+            "solve_warm_ms", fallback.get("solve_p50_ms", -1)
+        ) if fallback else -1
+        ora = fallback.get("oracle_ms") if fallback else None
         headline = {
             "metric": (
-                f"{fallback['config']}_warm_solve_p50"
+                f"{fallback['config']}_solve_p50"
                 if fallback
                 else "no_config_completed"
             ),
-            "value": fallback["solve_warm_ms"] if fallback else -1,
+            "value": val,
             "unit": "ms",
             "vs_baseline": (
-                round(fallback["oracle_ms"] / fallback["solve_warm_ms"], 2)
-                if fallback
-                else 0
+                round(ora / val, 2) if ora and val and val > 0 else 0
             ),
             "configs": rows,
         }

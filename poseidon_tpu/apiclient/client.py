@@ -124,8 +124,10 @@ class K8sApiClient:
                 ) as resp:
                     payload = resp.read()
                 return json.loads(payload) if payload else {}
-            except (urllib.error.URLError, json.JSONDecodeError,
-                    TimeoutError) as e:
+            except (OSError, json.JSONDecodeError) as e:
+                # OSError covers URLError, TimeoutError AND the raw
+                # socket errors (ConnectionResetError) that surface
+                # under concurrent bindings POSTs mid-body-read
                 last = e
                 if attempt < self.retries:
                     time.sleep(0.05 * (attempt + 1))

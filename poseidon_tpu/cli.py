@@ -197,11 +197,30 @@ def run_loop(args: argparse.Namespace) -> int:
                 log.exception("scheduling round failed; skipping tick")
                 time.sleep(args.polling_frequency / 1e6)
                 continue
-            for uid, machine in result.bindings.items():
-                task = bridge.tasks.get(uid)
-                ns = task.namespace if task else "default"
-                if client.bind_pod_to_node(uid, machine, namespace=ns):
-                    bridge.confirm_binding(uid, machine)
+            # bindings POST concurrently (bounded): serially, a
+            # 10k-placement round is 10k sequential HTTP round trips —
+            # the reference has the same flaw (one pplx chain joined
+            # per pod, k8s_api_client.cc:225). Confirmations apply on
+            # the main thread; the bridge is not thread-safe.
+            if result.bindings:
+                import concurrent.futures as _cf
+
+                def _bind(item):
+                    uid, machine = item
+                    task = bridge.tasks.get(uid)
+                    ns = task.namespace if task else "default"
+                    return uid, machine, client.bind_pod_to_node(
+                        uid, machine, namespace=ns
+                    )
+
+                workers = min(16, len(result.bindings))
+                with _cf.ThreadPoolExecutor(workers) as pool:
+                    outcomes = list(
+                        pool.map(_bind, result.bindings.items())
+                    )
+                for uid, machine, ok in outcomes:
+                    if ok:
+                        bridge.confirm_binding(uid, machine)
             s = result.stats
             log.info(
                 "round %d: pending=%d placed=%d unsched=%d cost=%d "

@@ -389,7 +389,9 @@ def _theta_clearing(dev: DenseInstance):
 
 @partial(
     jax.jit,
-    static_argnames=("alpha", "max_rounds", "smax", "analytic_init"),
+    static_argnames=(
+        "alpha", "max_rounds", "smax", "analytic_init", "collect_hist",
+    ),
 )
 def _solve(
     dev: DenseInstance,
@@ -401,6 +403,7 @@ def _solve(
     max_rounds: int,
     smax: int,
     analytic_init: bool = False,
+    collect_hist: bool = False,
 ):
     """Core loop. The carry is the MACHINE-SORTED seat layout
     ``(sm, slvl, st)`` — positions sorted by (segment, -level, task) —
@@ -635,10 +638,13 @@ def _solve(
 
         def run_round(_):
             sm2, slvl2, st2 = auction_round(sm, slvl, st, floor, eps, lay)
-            h = hist.at[jnp.minimum(phases, 31)].add(1)
-            h = h.at[jnp.minimum(phases, 31) + 96].add(
-                jnp.sum(waiting, dtype=I32)
-            )
+            h = hist
+            if collect_hist:
+                # debug-only: two extra scatter ops per round
+                h = h.at[jnp.minimum(phases, 31)].add(1)
+                h = h.at[jnp.minimum(phases, 31) + 96].add(
+                    jnp.sum(waiting, dtype=I32)
+                )
             return sm2, slvl2, st2, floor, eps, rounds + 1, phases, done, h
 
         def phase_shift(_):
@@ -672,9 +678,11 @@ def _solve(
 
             def refight(_):
                 sm2, slvl2, st2 = release(viol_now)
-                h = hist.at[jnp.minimum(phases, 31) + 32].add(
-                    jnp.sum(viol_now, dtype=I32)
-                )
+                h = hist
+                if collect_hist:
+                    h = h.at[jnp.minimum(phases, 31) + 32].add(
+                        jnp.sum(viol_now, dtype=I32)
+                    )
                 return (sm2, slvl2, st2, floor, eps, rounds + 1,
                         phases, done, h)
 
@@ -711,9 +719,11 @@ def _solve(
                 new_done = at_floor & ~any_viol2 & ~jnp.any(
                     ~full & (dev.s > 0) & (f1 > 0)
                 )
-                h = hist.at[jnp.minimum(phases, 31) + 64].add(
-                    jnp.sum(viol2, dtype=I32)
-                )
+                h = hist
+                if collect_hist:
+                    h = h.at[jnp.minimum(phases, 31) + 64].add(
+                        jnp.sum(viol2, dtype=I32)
+                    )
                 return (sm2, slvl2, st2, f1, next_eps, rounds + 1,
                         phases + 1, new_done, h)
 

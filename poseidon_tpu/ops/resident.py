@@ -324,13 +324,20 @@ class ResidentSolver:
         )
         timings["prep_ms"] = (time.perf_counter() - t0) * 1000
 
-        # ---- ONE batched upload --------------------------------------
+        # ---- upload + device chain + ONE sync ------------------------
+        # No intermediate block_until_ready: on this environment every
+        # host synchronization costs ~90 ms of tunnel-visibility
+        # latency, and blocking after the upload and after the solve
+        # (purely for per-phase timing attribution) tripled the round's
+        # wall time. The whole chain pipelines into the single
+        # device_get below; ``solve_ms`` therefore covers upload +
+        # pricing + densify + solve + finalize + completion, and
+        # ``upload_ms``/``fetch_ms`` record only dispatch/transfer
+        # bookkeeping around it.
         t0 = time.perf_counter()
         inputs_dev, dt = jax.device_put((inputs_host, dt_host))
-        jax.block_until_ready(dt.arc_unsched)
         timings["upload_ms"] = (time.perf_counter() - t0) * 1000
 
-        # ---- device-side chain, no host crossings --------------------
         t0 = time.perf_counter()
         cost = _jitted_model(cost_model)(inputs_dev)
         with jax.enable_x64(True):
@@ -343,18 +350,14 @@ class ResidentSolver:
         )
         with jax.enable_x64(True):
             ch_dev, primal = _finalize(dev, dt, pc_s, ra_s, state.asg)
-        jax.block_until_ready(state.asg)
-        timings["solve_ms"] = (time.perf_counter() - t0) * 1000
-
-        # ---- ONE batched download ------------------------------------
-        t0 = time.perf_counter()
         asg_np, ch_np, conv, rounds, phases, primal_np, dom_ok = (
             jax.device_get((
                 state.asg, ch_dev, state.converged, state.rounds,
                 state.phases, primal, domain_ok,
             ))
         )
-        timings["fetch_ms"] = (time.perf_counter() - t0) * 1000
+        timings["solve_ms"] = (time.perf_counter() - t0) * 1000
+        timings["fetch_ms"] = 0.0
 
         if not bool(dom_ok):
             self._warm = None
@@ -373,16 +376,13 @@ class ResidentSolver:
             )
             with jax.enable_x64(True):
                 ch_dev, primal = _finalize(dev, dt, pc_s, ra_s, state.asg)
-            jax.block_until_ready(state.asg)
-            timings["solve_ms"] += (time.perf_counter() - t0) * 1000
-            t0 = time.perf_counter()
             asg_np, ch_np, conv, rounds, phases, primal_np = (
                 jax.device_get((
                     state.asg, ch_dev, state.converged, state.rounds,
                     state.phases, primal,
                 ))
             )
-            timings["fetch_ms"] += (time.perf_counter() - t0) * 1000
+            timings["solve_ms"] += (time.perf_counter() - t0) * 1000
         if not bool(conv):
             self._warm = None
             return self._oracle_round(

@@ -82,10 +82,19 @@ class DenseTopology:
     n_tasks: jax.Array       # i32 scalar
 
 
-def pad_topology(topo: TransportTopology) -> DenseTopology:
-    """Host-side padding of the skeleton (numpy; upload happens batched)."""
+def pad_topology(
+    topo: TransportTopology, *, t_min: int = 16, m_min: int = 16
+) -> DenseTopology:
+    """Host-side padding of the skeleton (numpy; upload happens batched).
+
+    ``t_min``/``m_min`` are grow-only bucket floors from the owning
+    solver: with the fine (multiple-of-1024) bucket ladder, a task
+    count oscillating across a bucket boundary would otherwise
+    recompile the whole device chain every other round.
+    """
     T, M, P = topo.n_tasks, topo.n_machines, topo.max_prefs
-    Tp, Mp = pad_bucket(max(T, 1)), pad_bucket(max(M, 1))
+    Tp = pad_bucket(max(T, 1), minimum=t_min)
+    Mp = pad_bucket(max(M, 1), minimum=m_min)
 
     def pad1(x, size, fill):
         out = np.full(size, fill, np.int32)
@@ -271,6 +280,10 @@ class ResidentSolver:
         self.oracle_fallback = oracle_fallback
         self.oracle_timeout_s = oracle_timeout_s
         self._warm: DenseState | None = None
+        # grow-only padding-bucket floors (anti-recompile hysteresis)
+        self._e_floor = 16
+        self._t_floor = 16
+        self._m_floor = 16
 
     def reset(self) -> None:
         self._warm = None
@@ -296,7 +309,12 @@ class ResidentSolver:
         """
         timings: dict[str, float] = {}
         t0 = time.perf_counter()
-        E = pad_bucket(max(meta.n_arcs, 1))
+        # grow-only bucket floors: arc/task counts oscillating across a
+        # fine bucket boundary must not recompile the chain every round
+        self._e_floor = pad_bucket(
+            max(meta.n_arcs, 1), minimum=self._e_floor
+        )
+        E = self._e_floor
         inputs_host = build_cost_inputs_host(
             E, meta, **(cost_input_kwargs or {})
         )
@@ -315,7 +333,11 @@ class ResidentSolver:
                 why="not-scheduling-shaped",
             )
         T, P = topo.n_tasks, topo.max_prefs
-        dt_host = pad_topology(topo)
+        dt_host = pad_topology(
+            topo, t_min=self._t_floor, m_min=self._m_floor
+        )
+        self._t_floor = dt_host.arc_unsched.shape[0]
+        self._m_floor = dt_host.slots.shape[0]
         # power-of-two smax bound: top_k cost grows mildly with smax but
         # the static argument stays stable as per-round free slots churn
         smax = min(

@@ -203,6 +203,43 @@ class TestDegenerate:
             build_dense_instance(inst)
 
 
+class TestHistDebugPath:
+    def test_collect_hist_compiles_and_counts(self):
+        # the histogram is compile-time-gated debug instrumentation
+        # (two scatters/round, ~40% of a cold solve when left on);
+        # keep the debug variant compiling and self-consistent
+        import jax
+        import jax.numpy as jnp
+
+        from poseidon_tpu.ops.dense_auction import (
+            _solve,
+            build_dense_instance,
+            cold_start,
+        )
+        from tests.helpers import price, random_cluster
+
+        rng = np.random.default_rng(21)
+        cluster = random_cluster(rng, 6, 48)
+        from poseidon_tpu.graph.builder import FlowGraphBuilder
+        from poseidon_tpu.ops.transport import extract_instance
+
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "quincy", cluster)
+        dev = build_dense_instance(extract_instance(net, meta))
+        asg0, lvl0, floor0, eps0 = cold_start(dev)
+        with jax.enable_x64(True):
+            out = _solve(
+                dev, asg0, lvl0, floor0, eps0, 1024, 20_000,
+                dev.smax, analytic_init=True, collect_hist=True,
+            )
+        rounds, phases, hist = out[5], out[6], np.asarray(out[7])
+        assert bool(np.asarray(out[4])), "solve must certify"
+        # bid rounds + boundary steps == total rounds
+        bid_rounds = int(hist[:32].sum())
+        assert 0 < bid_rounds <= int(np.asarray(rounds))
+        assert int(np.asarray(phases)) >= 1
+
+
 class TestFrontDoor:
     def test_solve_scheduling_dense_path(self):
         rng = np.random.default_rng(21)

@@ -812,12 +812,25 @@ def _solve_cold(dev: DenseInstance, alpha: int, max_rounds: int,
     )
 
 
+def default_fuse(Tp: int, *, warm: bool = False) -> int:
+    """Round fuse: flat 20k.
+
+    An instance-scaled fuse (20 x Tp) was tried and REVERTED: price-war
+    length is governed by cost-range / eps, not task count — a tiny
+    oversubscribed 40-task instance legitimately needed >2k rounds cold,
+    and a 105-task warm re-solve with 5 arrivals needed >2.5k rounds at
+    eps = 1 — both certify exactly under the flat fuse. Solves that
+    exhaust it (3/240 in the adversarial sweep) surface
+    ``converged=False`` and fall back to the oracle."""
+    return 20_000
+
+
 def solve_dense(
     inst_dev: DenseInstance,
     *,
     warm: DenseState | None = None,
     alpha: int = 1024,
-    max_rounds: int = 20_000,
+    max_rounds: int | None = None,
 ) -> DenseState:
     """Run the auction on device; returns device-resident state.
 
@@ -826,7 +839,8 @@ def solve_dense(
     eps = 1 — the incremental re-solve path mirroring the reference's
     ``--run_incremental_scheduler`` seam (deploy/poseidon.cfg:12).
     No host synchronization happens here; read the result fields (one
-    device_get) only when needed.
+    device_get) only when needed. ``max_rounds=None`` uses the
+    instance-scaled ``default_fuse``.
     """
     Tp, Mp = inst_dev.c.shape
     smax = inst_dev.smax
@@ -834,6 +848,8 @@ def solve_dense(
         warm.asg.shape[0] != Tp or warm.floor.shape[0] != Mp
     ):
         warm = None  # cluster outgrew its padding bucket: cold solve
+    if max_rounds is None:
+        max_rounds = default_fuse(Tp, warm=warm is not None)
     with jax.enable_x64(True):
         if warm is None:
             asg, lvl, floor, gap, converged, rounds, phases, _ = (
@@ -916,7 +932,7 @@ def solve_transport_dense(
     *,
     warm: DenseState | None = None,
     alpha: int = 1024,
-    max_rounds: int = 20_000,
+    max_rounds: int | None = None,
 ) -> tuple[TransportResult, DenseState]:
     """Host-facing wrapper: densify, solve on device, read back once."""
     T = inst.n_tasks

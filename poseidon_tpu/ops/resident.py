@@ -271,7 +271,7 @@ class ResidentSolver:
         self,
         *,
         alpha: int = 1024,
-        max_rounds: int = 20_000,
+        max_rounds: int | None = None,
         oracle_fallback: bool = True,
         oracle_timeout_s: float = 1000.0,
     ):

@@ -69,7 +69,7 @@ def solve_dense_sharded(
     *,
     warm: DenseState | None = None,
     alpha: int = 1024,
-    max_rounds: int = 20_000,
+    max_rounds: int | None = None,
 ) -> DenseState:
     """Solve an instance previously laid out by ``shard_instance``.
 
@@ -96,7 +96,7 @@ _COLLECTIVE_OPS = (
 
 def collective_account(
     sharded: DenseInstance, *, alpha: int = 1024,
-    max_rounds: int = 20_000,
+    max_rounds: int | None = None,
 ) -> dict[str, int]:
     """Count the collectives XLA's SPMD partitioner inserted into the
     compiled sharded solve (optimized-HLO audit, SURVEY §2.4).
@@ -107,6 +107,10 @@ def collective_account(
     global lexicographic seat sort crosses shards. The returned counts
     are per compiled program (the while-loop body's collectives appear
     once — they run every round at O(M) bytes, never O(T x M))."""
+    from poseidon_tpu.ops.dense_auction import default_fuse
+
+    if max_rounds is None:
+        max_rounds = default_fuse(sharded.c.shape[0])
     asg0, lvl0, floor0, eps0 = cold_start(sharded, alpha)
     with jax.enable_x64(True):
         compiled = _solve.lower(

@@ -249,10 +249,17 @@ class K8sApiClient:
             phase = TaskPhase(phase_raw)
         except ValueError:
             phase = TaskPhase.UNKNOWN
+        ns = meta.get("namespace", "default")
+        job = meta.get("labels", {}).get("job-name", "")
         return Task(
-            uid=meta["name"],
-            namespace=meta.get("namespace", "default"),
-            job=meta.get("labels", {}).get("job-name", ""),
+            # namespace-qualified: pod (and job) names are only unique
+            # per namespace; the bridge keys all state by uid, and the
+            # graph builder buckets tasks by job_id — an unqualified
+            # job label would merge same-named jobs across namespaces
+            # into one unscheduled aggregator
+            uid=f"{ns}/{meta['name']}",
+            namespace=ns,
+            job=f"{ns}/{job}" if job else "",
             cpu_request=cpu,
             memory_request_kb=mem_kb,
             phase=phase,
@@ -266,7 +273,14 @@ class K8sApiClient:
         self, pod: str, node: str, namespace: str = "default"
     ) -> bool:
         """POST the binding that makes a placement real
-        (k8s_api_client.cc:67-94; body shape at :75-79)."""
+        (k8s_api_client.cc:67-94; body shape at :75-79).
+
+        ``pod`` may be a bare pod name (with ``namespace`` naming its
+        namespace) or a qualified ``"ns/name"`` uid as produced by
+        ``_parse_pod`` — the qualifier then wins over ``namespace``.
+        """
+        if "/" in pod:
+            namespace, pod = pod.split("/", 1)
         body = {
             "apiVersion": "v1",
             "kind": "Binding",

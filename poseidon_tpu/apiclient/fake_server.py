@@ -101,14 +101,18 @@ class FakeApiServer:
                         body = json.loads(self.rfile.read(n) or b"{}")
                         pod = body["metadata"]["name"]
                         node = body["target"]["name"]
-                        if pod not in server.pods:
-                            self._reply(404, {"error": f"no pod {pod}"})
+                        # pods are stored per namespace (the URL names
+                        # it) — same-named pods in two namespaces are
+                        # distinct objects, like the real apiserver
+                        key = f"{parts[3]}/{pod}"
+                        if key not in server.pods:
+                            self._reply(404, {"error": f"no pod {key}"})
                             return
                         if node not in server.nodes:
                             self._reply(404, {"error": f"no node {node}"})
                             return
-                        server._pending_bindings.append((pod, node))
-                        server.bindings.append((pod, node))
+                        server._pending_bindings.append((key, node))
+                        server.bindings.append((key, node))
                         self._reply(201, {"status": "Bound"})
                     else:
                         self._reply(404, {"error": self.path})
@@ -220,7 +224,7 @@ class FakeApiServer:
                 "poseidon.io/data-prefs": json.dumps(data_prefs)
             }
         with self._lock:
-            self.pods[name] = {
+            self.pods[f"{namespace}/{name}"] = {
                 "metadata": meta,
                 "spec": {
                     "containers": [
@@ -249,8 +253,9 @@ class FakeApiServer:
         with self._lock:
             self._truncate = n
 
-    def succeed_pod(self, name: str) -> None:
+    def succeed_pod(self, name: str, namespace: str = "default") -> None:
+        key = name if "/" in name else f"{namespace}/{name}"
         with self._lock:
-            doc = self.pods.get(name)
+            doc = self.pods.get(key)
             if doc is not None:
                 doc["status"]["phase"] = "Succeeded"

@@ -58,6 +58,12 @@ class Task:
     input bytes (scaled units) local there.
     """
 
+    # The scheduler-wide identity. The API client qualifies it as
+    # "{namespace}/{name}" — pod names are only unique per namespace, so
+    # keying bridge state by the bare name would collide two same-named
+    # pods from different namespaces into one task (state corruption the
+    # reference ducks only by hardcoding namespace "default",
+    # k8s_api_client.cc:222). Synthetic/test tasks may use bare uids.
     uid: str
     namespace: str = "default"
     job: str = ""
@@ -76,6 +82,12 @@ class Task:
     @property
     def job_id(self) -> str:
         return self.job or self.uid
+
+    @property
+    def name(self) -> str:
+        """Bare pod name (the uid without its namespace qualifier) —
+        what the k8s bindings POST wants in ``metadata.name``."""
+        return self.uid.split("/", 1)[1] if "/" in self.uid else self.uid
 
 
 @dataclasses.dataclass

@@ -507,6 +507,14 @@ def _solve(
         # Compaction by sort, not jnp.nonzero: nonzero lowers to a
         # prefix-scan (reduce-window) whose scoped-VMEM footprint blew
         # the 16 MB limit at 12k-machine shapes and under vmap.
+        # Fairness caveat: the window always takes the B lowest sorted
+        # positions (overflow holders first, then WAIT in task-id
+        # order), so with more than B waiting tasks the low-id ones
+        # monopolize bid slots and high-id ones can defer many rounds.
+        # Termination still holds (every rejected bid raises a price by
+        # >= eps, and the fuse/oracle fallback bounds the worst case);
+        # revisit with a round-rotated window start if fuse-exhaustion
+        # rates ever rise on heavily oversubscribed instances.
         bpos = jax.lax.sort(jnp.where(waiting, pos, Tp))[:B]
         bvalid = bpos < Tp
         bpos_safe = jnp.minimum(bpos, Tp - 1)
@@ -522,8 +530,14 @@ def _solve(
         # tasks). A per-task rotation spreads tied bidders uniformly
         # across their whole tie set in one round.
         midx = jnp.arange(Mp, dtype=I32)[None, :]
-        # 40503 = Knuth's 16-bit hash multiplier; product stays in i32
-        rot = (btask * 40503 % Mp).astype(I32)[:, None]
+        # 40503 = Knuth's 16-bit hash multiplier; the product runs in
+        # uint32 so it wraps (never UB, never negative) at any Tp —
+        # in int32 it would overflow past Tp ~ 53k and quietly weaken
+        # the hash spread
+        rot = (
+            (btask.astype(jnp.uint32) * jnp.uint32(40503))
+            % jnp.uint32(Mp)
+        ).astype(I32)[:, None]
         tie_rank = (midx - rot) % Mp
         m1 = jnp.argmin(
             jnp.where(vb == b1v[:, None], tie_rank, Mp + 1), axis=1
@@ -812,7 +826,7 @@ def _solve_cold(dev: DenseInstance, alpha: int, max_rounds: int,
     )
 
 
-def default_fuse(Tp: int, *, warm: bool = False) -> int:
+def default_fuse() -> int:
     """Round fuse: flat 20k.
 
     An instance-scaled fuse (20 x Tp) was tried and REVERTED: price-war
@@ -839,8 +853,8 @@ def solve_dense(
     eps = 1 — the incremental re-solve path mirroring the reference's
     ``--run_incremental_scheduler`` seam (deploy/poseidon.cfg:12).
     No host synchronization happens here; read the result fields (one
-    device_get) only when needed. ``max_rounds=None`` uses the
-    instance-scaled ``default_fuse``.
+    device_get) only when needed. ``max_rounds=None`` uses the flat
+    20k-round ``default_fuse``.
     """
     Tp, Mp = inst_dev.c.shape
     smax = inst_dev.smax
@@ -849,7 +863,7 @@ def solve_dense(
     ):
         warm = None  # cluster outgrew its padding bucket: cold solve
     if max_rounds is None:
-        max_rounds = default_fuse(Tp, warm=warm is not None)
+        max_rounds = default_fuse()
     with jax.enable_x64(True):
         if warm is None:
             asg, lvl, floor, gap, converged, rounds, phases, _ = (

@@ -110,7 +110,7 @@ def collective_account(
     from poseidon_tpu.ops.dense_auction import default_fuse
 
     if max_rounds is None:
-        max_rounds = default_fuse(sharded.c.shape[0])
+        max_rounds = default_fuse()
     asg0, lvl0, floor0, eps0 = cold_start(sharded, alpha)
     with jax.enable_x64(True):
         compiled = _solve.lower(

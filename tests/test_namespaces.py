@@ -1,0 +1,87 @@
+"""Namespace-qualified pod identity (round-4 verdict, Next #3).
+
+Pod names are only unique per namespace. The reference sidesteps this by
+hardcoding namespace "default" into its bindings POST
+(k8s_api_client.cc:222); this framework parses real namespaces, so its
+task identity must be the qualified "ns/name" pair — two same-named pods
+in different namespaces are distinct tasks with independent state and
+independent bindings.
+"""
+
+from poseidon_tpu.apiclient import FakeApiServer, K8sApiClient
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.cluster import Task
+
+
+class TestTaskName:
+    def test_qualified_uid_splits(self):
+        t = Task(uid="team-a/worker-0", namespace="team-a")
+        assert t.name == "worker-0"
+
+    def test_bare_uid_passthrough(self):
+        t = Task(uid="task-7")
+        assert t.name == "task-7"
+
+
+class TestSameNamedPodsAcrossNamespaces:
+    def test_distinct_tasks_and_independent_bindings(self):
+        with FakeApiServer() as server:
+            server.add_node("n0", cpu="8", memory="16Gi", pods=10)
+            server.add_node("n1", cpu="8", memory="16Gi", pods=10)
+            # identical pod NAME in two namespaces, different shapes —
+            # if identity collapsed to the bare name, one would
+            # overwrite the other in the bridge maps
+            server.add_pod(
+                "app-0", namespace="alpha", cpu="250m", memory="256Mi",
+                job="train",
+            )
+            server.add_pod(
+                "app-0", namespace="beta", cpu="500m", memory="512Mi",
+                job="train",
+            )
+
+            client = K8sApiClient("127.0.0.1", server.port)
+            pods = client.all_pods()
+            assert len(pods) == 2
+            uids = {p.uid for p in pods}
+            assert uids == {"alpha/app-0", "beta/app-0"}
+            # same-named JOBS stay distinct too — an unqualified job
+            # label would merge both namespaces' tasks under one
+            # unscheduled aggregator in the flow graph
+            assert {p.job_id for p in pods} == {
+                "alpha/train", "beta/train",
+            }
+            by_uid = {p.uid: p for p in pods}
+            assert by_uid["alpha/app-0"].cpu_request == 0.25
+            assert by_uid["beta/app-0"].cpu_request == 0.5
+
+            bridge = SchedulerBridge(cost_model="trivial")
+            bridge.observe_nodes(client.all_nodes())
+            bridge.observe_pods(pods)
+            result = bridge.run_scheduler()
+            # BOTH tasks schedule — no state collision ate one of them
+            assert set(result.bindings) == {"alpha/app-0", "beta/app-0"}
+
+            for uid, machine in result.bindings.items():
+                task = bridge.tasks[uid]
+                assert client.bind_pod_to_node(
+                    task.name, machine, namespace=task.namespace
+                )
+            assert sorted(k for k, _ in server.bindings) == [
+                "alpha/app-0", "beta/app-0",
+            ]
+
+            # next poll observes each binding on its own pod
+            pods2 = {p.uid: p for p in client.all_pods()}
+            for uid, machine in result.bindings.items():
+                assert pods2[uid].machine == machine
+
+    def test_qualified_uid_accepted_by_bindings_post(self):
+        with FakeApiServer() as server:
+            server.add_node("n0")
+            server.add_pod("solo", namespace="gamma")
+            client = K8sApiClient("127.0.0.1", server.port)
+            # the qualifier inside the pod id wins over the namespace
+            # keyword, so callers can pass the uid straight through
+            assert client.bind_pod_to_node("gamma/solo", "n0")
+            assert server.bindings == [("gamma/solo", "n0")]

@@ -39,7 +39,7 @@ class TestClientPagination:
             f"node-{i:03d}" for i in range(23)
         )
         assert sorted(p.uid for p in pods) == sorted(
-            f"pod-{i:03d}" for i in range(57)
+            f"default/pod-{i:03d}" for i in range(57)
         )
 
     def test_single_page_when_under_limit(self):
@@ -103,7 +103,7 @@ class TestMassEvictionGuard:
                 server.drop_node(f"node-{i:03d}")
             with server._lock:
                 for i in range(10, 40):
-                    server.pods.pop(f"pod-{i:03d}", None)
+                    server.pods.pop(f"default/pod-{i:03d}", None)
 
             for _ in range(SHRINK_STRIKES - 1):
                 self._observe(bridge, client)
@@ -158,7 +158,7 @@ class TestMassEvictionGuard:
             server.drop_node("node-009")
             with server._lock:
                 for i in range(35, 40):
-                    server.pods.pop(f"pod-{i:03d}", None)
+                    server.pods.pop(f"default/pod-{i:03d}", None)
             self._observe(bridge, client)
             assert len(bridge.machines) == 9
             assert len(bridge.tasks) == 35

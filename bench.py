@@ -51,6 +51,7 @@ def bench_config(
     solve_reps: int,
     oracle_reps: int,
     what_if: int = 0,
+    dispatch: bool = False,
 ) -> dict:
     """Time one ladder config end to end; returns the detail row."""
     import jax
@@ -235,13 +236,28 @@ def bench_config(
     row["decompose_ms"] = round((time.perf_counter() - t5) * 1000, 3)
     row["placed"] = len(placements)
 
-    oracles = []
-    oc = None
-    for _ in range(max(oracle_reps, 1)):
-        ta = time.perf_counter()
-        oc = solve_oracle(net, algorithm="cost_scaling")
-        oracles.append(time.perf_counter() - ta)
-    row["oracle_ms"] = _ms(oracles)
+    # CPU baseline: BOTH in-tree cost-scaling solvers — the plain
+    # Goldberg-Tarjan mode and the cs2-heuristics mode (CSR + FIFO +
+    # global price update; Goldberg's own cs2 sources are unreachable
+    # offline, so this tuned independent implementation is the
+    # strongest available stand-in). The headline baseline is the
+    # FASTEST of the two on each instance, so speedups are vs the best
+    # CPU number this environment can produce, not a strawman.
+    by_algo: dict[str, tuple[float, object]] = {}
+    for algo in ("cost_scaling", "cs2"):
+        ts = []
+        oc_a = None
+        for _ in range(max(oracle_reps, 1)):
+            ta = time.perf_counter()
+            oc_a = solve_oracle(net, algorithm=algo)
+            ts.append(time.perf_counter() - ta)
+        by_algo[algo] = (_ms(ts), oc_a)
+        row[f"oracle_{algo}_ms"] = _ms(ts)
+    assert by_algo["cost_scaling"][1].cost == by_algo["cs2"][1].cost
+    best = min(by_algo, key=lambda a: by_algo[a][0])
+    row["oracle_ms"] = by_algo[best][0]
+    row["oracle_algo"] = best
+    oc = by_algo[best][1]
     row["oracle_cost"] = int(oc.cost)
     row["exact"] = bool(res.cost == oc.cost)
     if row["solve_p50_ms"] > 0:
@@ -259,6 +275,32 @@ def bench_config(
         row["pods_per_sec"] = round(
             inst.n_tasks / (row["solve_warm_churn_ms"] / 1000), 1
         )
+
+    if dispatch:
+        # the front-door dispatcher (round-4 verdict Next #8): tiny
+        # instances route to the subprocess oracle instead of paying
+        # the TPU launch floor, so the framework's config-1 solve time
+        # IS the dispatcher's path. Measure it and, when the dispatcher
+        # chose a non-dense backend, report the headline speedup from
+        # its time (the dense-kernel numbers above stay in the row).
+        from poseidon_tpu.solver import solve_scheduling
+
+        outd = solve_scheduling(net, meta)  # warm the lane
+        disp = []
+        for _ in range(max(oracle_reps, 3)):
+            ta = time.perf_counter()
+            outd = solve_scheduling(net, meta)
+            disp.append(time.perf_counter() - ta)
+        row["dispatch_backend"] = outd.backend
+        row["dispatch_p50_ms"] = _ms(disp)
+        row["dispatch_exact"] = bool(outd.cost == oc.cost)
+        if outd.backend != "dense_auction" and row["dispatch_p50_ms"] > 0:
+            row["speedup_dense_kernel_vs_oracle"] = row.get(
+                "speedup_vs_oracle"
+            )
+            row["speedup_vs_oracle"] = round(
+                row["oracle_ms"] / row["dispatch_p50_ms"], 2
+            )
 
     if what_if:
         try:
@@ -285,8 +327,136 @@ def bench_config(
     return row
 
 
+def bench_tunnel() -> dict:
+    """Driver-visible microbench of the TPU link itself (round-4
+    verdict, Next #1/#4): how much of every reported solve time is the
+    environment's dispatch/sync floor rather than compute.
+
+    Measures, on whatever device the driver gives us:
+
+    - ``sync_floor_ms``: dispatch ONE trivial dependent op and block —
+      the minimum cost of any host-visible round trip. Every
+      per-round number that must read a result back (e.g. trace-replay
+      rounds) pays this once per round, whatever the compute was.
+    - ``dispatch_ms``: per-dispatch cost of back-to-back eager
+      dispatches with one final block (the pipelined regime the p50
+      solve numbers are measured in).
+    - ``inloop_tiny_op_ms`` / ``inloop_table_pass_ms`` /
+      ``inloop_sort16k_ms``: per-iteration cost of a data-dependent op
+      chain inside ONE compiled loop — an 8-element op, a full
+      [4096, 1024] table sweep (4M int32), and a 16k-key sort (the
+      solver's hot op classes).
+      When these are close, per-op cost is a launch floor, not
+      bandwidth — so solver time scales with OP COUNT, not elements,
+      and the same program on a directly-attached part (floor ~us, not
+      ~0.5 ms) runs an order of magnitude faster. That arithmetic is
+      how the cold-solve numbers should be read (PERF.md).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    row: dict = {}
+    small = jax.device_put(jnp.zeros(8, jnp.int32))
+    table = jax.device_put(
+        jnp.ones((4096, 1024), jnp.int32)
+    )
+
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    # warm compiles
+    jax.block_until_ready(tiny(small))
+
+    ts = []
+    for _ in range(12):
+        t0 = time.perf_counter()
+        jax.block_until_ready(tiny(small))
+        ts.append(time.perf_counter() - t0)
+    row["sync_floor_ms"] = _ms(ts)
+
+    reps = 40
+    x = small
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        x = tiny(x)
+    jax.block_until_ready(x)
+    row["dispatch_ms"] = round(
+        (time.perf_counter() - t0) * 1000 / reps, 3
+    )
+
+    # Loop bodies carry their operands so XLA cannot hoist the work out
+    # of the loop (a constant table's reduction is loop-invariant and
+    # gets computed once — measured: it made a 16 MB sweep read as
+    # 0.2 us/iter).
+    iters = 256
+
+    @jax.jit
+    def loop_tiny(x):
+        return jax.lax.fori_loop(0, iters, lambda i, v: v + i, x)
+
+    @jax.jit
+    def loop_table(x, c):
+        def body(i, carry):
+            v, cc = carry
+            cc = jnp.minimum(cc + v[0] + 1, jnp.int32(2**28))
+            return v + jnp.min(cc, axis=1)[:8], cc
+
+        return jax.lax.fori_loop(0, iters, body, (x, c))
+
+    sort_iters = 64
+    keys = jax.device_put(
+        jnp.arange(16384, dtype=jnp.int32)[::-1].copy()
+    )
+
+    @jax.jit
+    def loop_sort(x, k):
+        def body(i, carry):
+            v, kk = carry
+            kk = jax.lax.sort(kk ^ (v[0] & 7))
+            return v + kk[:8], kk
+
+        return jax.lax.fori_loop(0, sort_iters, body, (x, k))
+
+    jax.block_until_ready(loop_tiny(small))
+    jax.block_until_ready(loop_table(small, table))
+    jax.block_until_ready(loop_sort(small, keys))
+    t0 = time.perf_counter()
+    jax.block_until_ready(loop_tiny(small))
+    row["inloop_tiny_op_ms"] = round(
+        (time.perf_counter() - t0) * 1000 / iters, 4
+    )
+    t0 = time.perf_counter()
+    jax.block_until_ready(loop_table(small, table))
+    row["inloop_table_pass_ms"] = round(
+        (time.perf_counter() - t0) * 1000 / iters, 4
+    )
+    t0 = time.perf_counter()
+    jax.block_until_ready(loop_sort(small, keys))
+    row["inloop_sort16k_ms"] = round(
+        (time.perf_counter() - t0) * 1000 / sort_iters, 4
+    )
+
+    host = np.zeros(1 << 20, np.int32)  # 4 MiB
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        d = jax.device_put(host)
+        jax.block_until_ready(d)
+        ts.append(time.perf_counter() - t0)
+    row["put_4mb_ms"] = _ms(ts)
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.device_get(d)
+        ts.append(time.perf_counter() - t0)
+    row["get_4mb_ms"] = _ms(ts)
+    return row
+
+
 def bench_trace_replay(
-    *, n_machines: int = 12_000, rounds: int = 12, seed: int = 0
+    *, n_machines: int = 12_000, rounds: int = 12, seed: int = 0,
+    sync_floor_ms: float = 0.0,
 ) -> dict:
     """BASELINE config 4: incremental delta rounds at 12k machines.
 
@@ -382,6 +552,21 @@ def bench_trace_replay(
     row["all_dense"] = all(
         s.backend == "dense_auction" for s in steady
     )
+    # Every replay round is serially host-dependent (bindings feed the
+    # next round's capacity math), so each pays exactly ONE result
+    # readback — and on this driver's tunnel a single host-visible sync
+    # costs sync_floor_ms (measured by bench_tunnel) regardless of
+    # compute. The *_net_of_sync columns are the device-compute time a
+    # directly-attached deployment would see; the raw columns are what
+    # this tunnel measures.
+    if sync_floor_ms > 0:
+        row["sync_floor_ms"] = sync_floor_ms
+        row["solve_p50_net_of_sync_ms"] = round(
+            max(row["solve_p50_ms"] - sync_floor_ms, 0.0), 3
+        )
+        row["total_p50_net_of_sync_ms"] = round(
+            max(row["total_p50_ms"] - sync_floor_ms, 0.0), 3
+        )
     return row
 
 
@@ -406,14 +591,19 @@ def main() -> int:
     backend = jax.devices()[0]
     log(f"bench: device = {backend}")
 
+    try:
+        tunnel = bench_tunnel()
+        log(f"bench: tunnel microbench: {json.dumps(tunnel)}")
+    except Exception:
+        log(f"bench: tunnel microbench FAILED:\n{traceback.format_exc()}")
+        tunnel = {}
+
     ladder = {
         1: ("trivial_10n_100p", synth.config1_trivial_small, "trivial", 0),
         2: ("quincy_1k_10k", synth.config2_quincy_flagship, "quincy", 0),
         3: ("coco_1k_8k", synth.config3_coco, "coco", 0),
-        # round 3 benched 64 toy variants where serial CPU wins; the
-        # capability exists at scale: 8 flagship-class variants in one
-        # lockstep program (VERDICT round 3, Next #5)
-        5: ("whatif_x8_1k4k", synth.config5_whatif, "quincy", 8),
+        # BASELINE spec is x64 variants (ladder item 5)
+        5: ("whatif_x64_1k4k", synth.config5_whatif, "quincy", 64),
     }
 
     rows = []
@@ -421,7 +611,9 @@ def main() -> int:
         if num == 4:
             log("bench: running config 4 (trace_replay_12k) ...")
             try:
-                row = bench_trace_replay()
+                row = bench_trace_replay(
+                    sync_floor_ms=tunnel.get("sync_floor_ms", 0.0)
+                )
                 row["config_num"] = 4
                 rows.append(row)
                 log(f"bench: config 4 done: {json.dumps(row)}")
@@ -444,6 +636,9 @@ def main() -> int:
                 solve_reps=args.solve_reps,
                 oracle_reps=args.oracle_reps,
                 what_if=what_if,
+                # config 1 is under the small-instance thresholds: the
+                # dispatcher's choice is the framework's solve there
+                dispatch=(num == 1),
             )
             row["config_num"] = num
             rows.append(row)
@@ -473,6 +668,7 @@ def main() -> int:
             "converged": flagship["converged"]
             and flagship.get("warm_churn_all_converged", True),
             "device": str(backend),
+            "tunnel": tunnel,
             "configs": rows,
         }
     else:

@@ -103,6 +103,7 @@ class SchedulerBridge:
         sample_queue_size: int = 100,
         trace: TraceGenerator | None = None,
         solver_timeout_s: float = 1000.0,
+        small_to_oracle: bool = True,
     ):
         self.cost_model = cost_model
         self.max_tasks_per_machine = max_tasks_per_machine
@@ -114,7 +115,10 @@ class SchedulerBridge:
         self.round_num = 0
         # device-resident solve chain; its warm DenseState lives on HBM
         # across rounds (the reference's --run_incremental_scheduler seam)
-        self.solver = ResidentSolver(oracle_timeout_s=solver_timeout_s)
+        self.solver = ResidentSolver(
+            oracle_timeout_s=solver_timeout_s,
+            small_to_oracle=small_to_oracle,
+        )
         # bounded: a daemon running forever must not grow without bound
         # (full history goes to the trace stream when a sink is set)
         self.decision_log: collections.deque[tuple[int, str, str]] = (

@@ -163,6 +163,11 @@ def solve_what_if(
 ) -> BatchResult:
     """Solve ``n_variants`` perturbed copies of ``inst`` in one program."""
     dev = build_dense_instance(inst)
+    # the batch holds n_variants full cost tables at once — the memory
+    # guard must scale with the batch, not just the single instance
+    from poseidon_tpu.ops.dense_auction import check_table_budget
+
+    check_table_budget(dev.c.shape[0], dev.c.shape[1], n_variants)
     with jax.enable_x64(True):
         # perturb_costs does its jitter math in int64; outside this
         # context the casts silently truncate to int32 (round-3 advisor)

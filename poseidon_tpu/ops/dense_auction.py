@@ -60,6 +60,7 @@ download batch per result).
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 
 import jax
@@ -94,6 +95,38 @@ MAX_SCALED_COST = 2**27     # guard: scaled costs must stay below this
 
 class CostDomainTooLarge(ValueError):
     """Scaled costs exceed the int32 auction domain; use a fallback."""
+
+
+class DenseMemoryTooLarge(ValueError):
+    """The dense [Tp, Mp] table would blow the HBM budget; use a
+    fallback instead of OOMing mid-solve."""
+
+
+# HBM envelope for the dense [Tp, Mp] int32 cost table — the footprint
+# that dominates the solve (the kernel's transients — the bid window,
+# sort buffers, the densify min-chain — are a small multiple of it, and
+# XLA buffer-assigns within a few x of the table). 2 GiB default leaves
+# that multiple well inside a v5e's 16 GiB; override for bigger parts
+# via POSEIDON_TPU_DENSE_TABLE_BUDGET_MB. Oversize instances raise
+# DenseMemoryTooLarge and the front doors degrade LOUDLY to the oracle
+# (a 64k-task x 16k-machine cluster must fall back, not OOM).
+DENSE_TABLE_BUDGET_BYTES = (
+    int(os.environ.get("POSEIDON_TPU_DENSE_TABLE_BUDGET_MB", "2048"))
+    << 20
+)
+
+
+def check_table_budget(Tp: int, Mp: int, n_variants: int = 1) -> None:
+    """Raise DenseMemoryTooLarge if n_variants dense [Tp, Mp] i32
+    tables exceed the configured HBM budget."""
+    need = Tp * Mp * 4 * n_variants
+    if need > DENSE_TABLE_BUDGET_BYTES:
+        raise DenseMemoryTooLarge(
+            f"dense cost table {n_variants} x [{Tp}, {Mp}] i32 = "
+            f"{need >> 20} MiB exceeds the "
+            f"{DENSE_TABLE_BUDGET_BYTES >> 20} MiB budget "
+            f"(POSEIDON_TPU_DENSE_TABLE_BUDGET_MB)"
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -163,6 +196,7 @@ def build_dense_instance(inst: TransportInstance) -> DenseInstance:
     T, M, P = inst.n_tasks, inst.n_machines, inst.max_prefs
     Tp = pad_bucket(max(T, 1))
     Mp = pad_bucket(max(M, 1))
+    check_table_budget(Tp, Mp)
     scale = np.int64(T + 1)
 
     cmax = 0

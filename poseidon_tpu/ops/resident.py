@@ -27,6 +27,7 @@ the priced arc table, only on the rare round that needs it.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from functools import partial
 
@@ -43,8 +44,10 @@ from poseidon_tpu.ops.dense_auction import (
     INF,
     MAX_SCALED_COST,
     DenseInstance,
+    DenseMemoryTooLarge,
     DenseState,
     _densify,
+    check_table_budget,
     solve_dense,
 )
 from poseidon_tpu.ops.transport import (
@@ -56,6 +59,8 @@ from poseidon_tpu.ops.transport import (
     extract_topology,
     instance_from_topology,
 )
+
+log = logging.getLogger(__name__)
 
 
 @jax.tree_util.register_dataclass
@@ -274,11 +279,16 @@ class ResidentSolver:
         max_rounds: int | None = None,
         oracle_fallback: bool = True,
         oracle_timeout_s: float = 1000.0,
+        small_to_oracle: bool = True,
     ):
         self.alpha = alpha
         self.max_rounds = max_rounds
         self.oracle_fallback = oracle_fallback
         self.oracle_timeout_s = oracle_timeout_s
+        # dispatch heuristic: tiny instances go straight to the oracle
+        # (the TPU per-launch floor exceeds the whole subprocess solve
+        # there — solver.SMALL_INSTANCE_* documents the measurement)
+        self.small_to_oracle = small_to_oracle
         self._warm: DenseState | None = None
         # grow-only padding-bucket floors (anti-recompile hysteresis)
         self._e_floor = 16
@@ -333,9 +343,53 @@ class ResidentSolver:
                 why="not-scheduling-shaped",
             )
         T, P = topo.n_tasks, topo.max_prefs
+        from poseidon_tpu.solver import (
+            SMALL_INSTANCE_MACHINES,
+            SMALL_INSTANCE_TASKS,
+        )
+
+        if (
+            self.small_to_oracle
+            and self.oracle_fallback
+            and self._warm is None
+            and T <= SMALL_INSTANCE_TASKS
+            and topo.n_machines <= SMALL_INSTANCE_MACHINES
+        ):
+            # tiny instance: the subprocess oracle beats the TPU launch
+            # floor; price on device (the models want device inputs)
+            # and solve the round there
+            inputs_dev = jax.device_put(inputs_host)
+            cost = _jitted_model(cost_model)(inputs_dev)
+            return self._oracle_round(
+                arrays, meta, topo, cost, timings, why="small-instance"
+            )
         dt_host = pad_topology(
             topo, t_min=self._t_floor, m_min=self._m_floor
         )
+        try:
+            check_table_budget(
+                dt_host.arc_unsched.shape[0], dt_host.slots.shape[0]
+            )
+        except DenseMemoryTooLarge as e:
+            # degrade loudly BEFORE any device allocation: the guard,
+            # not an OOM mid-_redensify, decides oversize instances.
+            # The grow-only padding floors reset too: a floor raised by
+            # a past larger cluster must not keep re-padding a fitting
+            # instance over budget forever (the cost is one recompile)
+            self._warm = None
+            self._t_floor = 16
+            self._m_floor = 16
+            if not self.oracle_fallback:
+                raise
+            log.warning(
+                "resident round exceeds the dense HBM budget (%s); "
+                "degrading to oracle", e,
+            )
+            inputs_dev = jax.device_put(inputs_host)
+            cost = _jitted_model(cost_model)(inputs_dev)
+            return self._oracle_round(
+                arrays, meta, topo, cost, timings, why="memory-envelope"
+            )
         self._t_floor = dt_host.arc_unsched.shape[0]
         self._m_floor = dt_host.slots.shape[0]
         # power-of-two smax bound: top_k cost grows mildly with smax but

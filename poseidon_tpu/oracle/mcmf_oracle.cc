@@ -14,8 +14,18 @@
 //   cost_scaling  Goldberg-Tarjan cost-scaling push-relabel on the
 //                 min-cost circulation with a -BIG forcing arc
 //                 (cs2-family)
+//   cs2           tuned cost-scaling with cs2's signature heuristics:
+//                 flat CSR edge arrays, FIFO discharge, and the global
+//                 price-update heuristic (multi-source shortest-path in
+//                 eps units from deficit nodes, applied at refine start
+//                 and periodically between relabels). Goldberg's actual
+//                 cs2 sources are not obtainable in this offline build
+//                 environment; this is an independent implementation of
+//                 the same algorithm family and heuristics, kept as the
+//                 STRONGEST CPU baseline so the >=20x comparison is
+//                 against a tuned solver, not a strawman.
 //
-// Both are exact over int64 arithmetic.
+// All are exact over int64 arithmetic (prices in int128).
 //
 // I/O contract:
 //   stdin:  DIMACS min ("p min N M", "n id supply", "a src dst 0 cap cost")
@@ -31,7 +41,9 @@
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <limits>
 #include <queue>
 #include <string>
@@ -261,12 +273,235 @@ struct Solver {
   }
 };
 
+// ---- cs2-class tuned cost-scaling ------------------------------------
+// Independent implementation of the cs2 algorithm family (Goldberg's
+// cost-scaling push-relabel) with its documented performance heuristics:
+//  - flat CSR edge arrays (cache-friendly adjacency, no per-node vectors)
+//  - FIFO discharge of active nodes
+//  - the GLOBAL PRICE UPDATE heuristic: a multi-source shortest-path in
+//    eps units from deficit nodes, run at each refine start and again
+//    every O(n) relabels, collapsing long relabel waves into one pass.
+// Exact over int64 flows with int128 prices (arbitrary DIMACS costs).
+struct CS2Solver {
+  int n_ = 0;
+  long m_ = 0;  // directed edge slots (forward + backward)
+  std::vector<int> first_;   // CSR offsets, size n_+1
+  std::vector<int> head_;    // edge target
+  std::vector<i64> resid_;   // residual capacity
+  std::vector<i64> cost_;    // unit cost (unscaled)
+  std::vector<int> rev_;     // paired reverse edge id
+  std::vector<int> input_edge_;  // input arc a -> forward edge id
+  std::vector<i64> input_cap_;
+
+  // build-time edge staging (from, to, cap, cost); CSR assembled once
+  std::vector<std::array<i64, 4>> staged_;
+  std::vector<int> staged_input_;  // indices into staged_ of input arcs
+  std::vector<int> staged_fwd_;   // staged index -> forward edge id
+
+  void Init(int n) { n_ = n; }
+
+  // returns the staged index (resolve to an edge id via staged_fwd_
+  // after Assemble)
+  int AddEdgeStaged(int from, int to, i64 cap, i64 cost, bool input) {
+    if (input) staged_input_.push_back((int)staged_.size());
+    staged_.push_back({from, to, cap, cost});
+    return (int)staged_.size() - 1;
+  }
+
+  void Assemble() {
+    long E = (long)staged_.size();
+    m_ = 2 * E;
+    std::vector<int> deg(n_ + 1, 0);
+    for (auto& e : staged_) {
+      deg[(int)e[0] + 1]++;
+      deg[(int)e[1] + 1]++;
+    }
+    first_.assign(n_ + 1, 0);
+    for (int v = 1; v <= n_; ++v) first_[v] = first_[v - 1] + deg[v];
+    head_.assign(m_, 0);
+    resid_.assign(m_, 0);
+    cost_.assign(m_, 0);
+    rev_.assign(m_, 0);
+    std::vector<int> fill(first_.begin(), first_.end() - 1);
+    std::vector<int> fwd_id(E), bwd_id(E);
+    for (long a = 0; a < E; ++a) {
+      int u = (int)staged_[a][0], v = (int)staged_[a][1];
+      fwd_id[a] = fill[u]++;
+      bwd_id[a] = fill[v]++;
+    }
+    for (long a = 0; a < E; ++a) {
+      int u = (int)staged_[a][0], v = (int)staged_[a][1];
+      int f = fwd_id[a], b = bwd_id[a];
+      head_[f] = v; resid_[f] = staged_[a][2]; cost_[f] = staged_[a][3];
+      rev_[f] = b;
+      head_[b] = u; resid_[b] = 0; cost_[b] = -staged_[a][3];
+      rev_[b] = f;
+    }
+    input_edge_.reserve(staged_input_.size());
+    for (int a : staged_input_) {
+      input_edge_.push_back(fwd_id[a]);
+      input_cap_.push_back(staged_[a][2]);
+    }
+    staged_fwd_ = std::move(fwd_id);
+    staged_.clear();
+    staged_.shrink_to_fit();
+  }
+
+  i64 FlowOnInputArc(size_t a) const {
+    return input_cap_[a] - resid_[input_edge_[a]];
+  }
+
+  // Tuning knobs, measured on the BASELINE ladder instances (flagship
+  // Quincy 1k x 10k, CoCo 1k x 8k): alpha 8-12 tie within noise and
+  // beat 4/16/32; the PERIODIC mid-refine update consistently LOSES on
+  // these shallow scheduling graphs (the refine-start update already
+  // settles the 4-layer price landscape, and each periodic update pays
+  // a full Dijkstra plus a mandatory arc-cursor reset), so it defaults
+  // off. update_div == 0 disables it (the refine-start update always
+  // runs). Net vs the plain cost_scaling mode: ~1.2-1.5x faster
+  // (flagship 168 vs 228 ms, coco ~80 vs 112 ms).
+  i64 alpha_ = 12;
+  long update_div_ = 0;  // if >0, also update every n_/update_div_ relabels
+
+  // Solve the forced circulation; returns the exact cost over the
+  // input arcs (the caller reads routed flow off the forcing edge).
+  i64 Solve(i64 scale, i64 eps0, i64 alpha) {
+    std::vector<i128> price(n_, 0);
+    std::vector<i64> excess(n_, 0);
+    std::vector<int> cur(n_, 0);
+    std::deque<int> fifo;
+    std::vector<char> in_q(n_, 0);
+
+    auto rc = [&](int v, int e) -> i128 {
+      return (i128)cost_[e] * scale + price[v] - price[head_[e]];
+    };
+
+    // global price update: k[v] = least relabel count (in eps units)
+    // opening an admissible path to a deficit; price[v] -= k[v]*eps.
+    // Dijkstra over lengths max(0, floor(rc/eps) + 1).
+    std::vector<i64> kdist(n_);
+    using QE = std::pair<i64, int>;
+    auto price_update = [&](i64 eps) {
+      std::priority_queue<QE, std::vector<QE>, std::greater<QE>> pq;
+      std::fill(kdist.begin(), kdist.end(), kInf);
+      for (int v = 0; v < n_; ++v)
+        if (excess[v] < 0) { kdist[v] = 0; pq.push({0, v}); }
+      if (pq.empty()) return;
+      while (!pq.empty()) {
+        auto [d, v] = pq.top(); pq.pop();
+        if (d > kdist[v]) continue;
+        // scan IN-arcs of v = reverse edges out of v with residual on
+        // the paired edge; CSR stores both directions adjacently, so
+        // walk v's list and use the reverse pairing
+        for (int e = first_[v]; e < first_[v + 1]; ++e) {
+          int u = head_[e];           // candidate predecessor
+          int er = rev_[e];           // u -> v edge
+          if (resid_[er] <= 0) continue;
+          i128 r = rc(u, er);
+          // length in eps units to make u->v admissible after lowering
+          // price[u] by k*eps: need rc - k*eps < 0 => k > rc/eps
+          i64 len = r < 0 ? 0 : (i64)(r / eps) + 1;
+          i64 nd = d + len;
+          if (nd < kdist[u]) { kdist[u] = nd; pq.push({nd, u}); }
+        }
+      }
+      i64 kmax = 0;
+      for (int v = 0; v < n_; ++v)
+        if (kdist[v] < kInf && kdist[v] > kmax) kmax = kdist[v];
+      for (int v = 0; v < n_; ++v) {
+        i64 k = kdist[v] < kInf ? kdist[v] : kmax + 1;
+        price[v] -= (i128)k * eps;
+      }
+    };
+
+    i64 eps = eps0;
+    const long update_every =
+        update_div_ > 0 ? std::max<long>(256, n_ / update_div_)
+                        : std::numeric_limits<long>::max();
+    while (true) {
+      // refine(eps): saturate all negative-reduced-cost arcs
+      for (int v = 0; v < n_; ++v) {
+        for (int e = first_[v]; e < first_[v + 1]; ++e) {
+          if (resid_[e] > 0 && rc(v, e) < 0) {
+            excess[v] -= resid_[e];
+            excess[head_[e]] += resid_[e];
+            resid_[rev_[e]] += resid_[e];
+            resid_[e] = 0;
+          }
+        }
+      }
+      price_update(eps);
+      std::fill(cur.begin(), cur.end(), 0);
+      fifo.clear();
+      std::fill(in_q.begin(), in_q.end(), 0);
+      for (int v = 0; v < n_; ++v)
+        if (excess[v] > 0) { fifo.push_back(v); in_q[v] = 1; }
+      long relabels = 0;
+
+      while (!fifo.empty()) {
+        int v = fifo.front();
+        fifo.pop_front();
+        in_q[v] = 0;
+        while (excess[v] > 0) {
+          if (cur[v] == first_[v + 1] - first_[v]) {
+            // relabel to the largest admissible-making price
+            bool any = false;
+            i128 best = 0;
+            for (int e = first_[v]; e < first_[v + 1]; ++e) {
+              if (resid_[e] > 0) {
+                i128 np =
+                    price[head_[e]] - (i128)cost_[e] * scale - eps;
+                if (!any || np > best) { best = np; any = true; }
+              }
+            }
+            if (!any) {
+              std::fprintf(stderr, "cs2: stuck node %d\n", v);
+              std::exit(3);  // cannot happen in a circulation
+            }
+            price[v] = best;
+            cur[v] = 0;
+            if (++relabels % update_every == 0) {
+              price_update(eps);
+              // prices moved globally: restart arc cursors
+              std::fill(cur.begin(), cur.end(), 0);
+            }
+          }
+          int e = first_[v] + cur[v];
+          if (resid_[e] > 0 && rc(v, e) < 0) {
+            i64 push = std::min(excess[v], resid_[e]);
+            resid_[e] -= push;
+            resid_[rev_[e]] += push;
+            excess[v] -= push;
+            int w = head_[e];
+            bool was_inactive = excess[w] <= 0;
+            excess[w] += push;
+            if (was_inactive && excess[w] > 0 && !in_q[w]) {
+              fifo.push_back(w);
+              in_q[w] = 1;
+            }
+          } else {
+            ++cur[v];
+          }
+        }
+      }
+      if (eps == 1) break;
+      eps = std::max<i64>(1, eps / alpha);
+    }
+
+    i64 cost = 0;
+    for (size_t a = 0; a < input_edge_.size(); ++a)
+      cost += FlowOnInputArc(a) * cost_[input_edge_[a]];
+    return cost;
+  }
+};
+
 }  // namespace
 
 int main(int argc, char** argv) {
   std::string algo = argc > 1 ? argv[1] : "ssp";
-  if (algo != "ssp" && algo != "cost_scaling") {
-    std::fprintf(stderr, "usage: %s [ssp|cost_scaling] < dimacs\n", argv[0]);
+  if (algo != "ssp" && algo != "cost_scaling" && algo != "cs2") {
+    std::fprintf(stderr, "usage: %s [ssp|cost_scaling|cs2] < dimacs\n",
+                 argv[0]);
     return 2;
   }
 
@@ -319,14 +554,66 @@ int main(int argc, char** argv) {
 
   // Super source/sink framing.
   int S = n, T = n + 1;
+  i64 total_supply = 0;
+  for (int v = 0; v < n; ++v)
+    if (supply[v] > 0) total_supply += supply[v];
+
+  if (algo == "cs2") {
+    CS2Solver cs2;
+    cs2.Init(n + 2);
+    for (auto& a : arcs)
+      cs2.AddEdgeStaged((int)a[0], (int)a[1], a[2], a[3], true);
+    i64 maxc = 0;
+    for (auto& a : arcs) maxc = std::max(maxc, a[3] < 0 ? -a[3] : a[3]);
+    for (int v = 0; v < n; ++v) {
+      if (supply[v] > 0) cs2.AddEdgeStaged(S, v, supply[v], 0, false);
+      else if (supply[v] < 0) cs2.AddEdgeStaged(v, T, -supply[v], 0, false);
+    }
+    const i64 big = (maxc + 1) * (i64)(n + 3);
+    int force_staged =
+        cs2.AddEdgeStaged(T, S, total_supply, -big, false);
+    cs2.Assemble();
+    int force_edge = cs2.staged_fwd_[force_staged];
+
+    const i64 scale = (i64)(n + 2);
+    i64 eps0 = big * scale;
+    // optional tuning overrides: mcmf_oracle cs2 [alpha] [update_div]
+    if (argc > 2) cs2.alpha_ = std::atoll(argv[2]);
+    if (argc > 3) cs2.update_div_ = std::atol(argv[3]);
+    if (cs2.alpha_ < 2) {
+      // alpha 0 would SIGFPE on the eps division and alpha 1 would
+      // never shrink eps (infinite scaling loop)
+      std::fprintf(stderr, "cs2: alpha must be >= 2 (got %lld)\n",
+                   (long long)cs2.alpha_);
+      return 2;
+    }
+    auto t0 = std::chrono::steady_clock::now();
+    i64 cost = cs2.Solve(scale, eps0, cs2.alpha_);
+    auto t1 = std::chrono::steady_clock::now();
+    double ms = std::chrono::duration<double, std::milli>(t1 - t0).count();
+
+    i64 routed = total_supply - cs2.resid_[force_edge];
+    if (routed != total_supply) {
+      std::printf("c infeasible routed=%lld of %lld\n", (long long)routed,
+                  (long long)total_supply);
+      return 1;
+    }
+    std::printf("s %lld\n", (long long)cost);
+    for (size_t a = 0; a < arcs.size(); ++a) {
+      std::printf("f %lld %lld %lld\n", (long long)(arcs[a][0] + 1),
+                  (long long)(arcs[a][1] + 1),
+                  (long long)cs2.FlowOnInputArc(a));
+    }
+    std::printf("c time_ms %.3f\n", ms);
+    return 0;
+  }
+
   solver.Init(n + 2);
   for (auto& a : arcs)
     solver.AddInputArc((int)a[0], (int)a[1], a[2], a[3]);
-  i64 total_supply = 0;
   for (int v = 0; v < n; ++v) {
     if (supply[v] > 0) {
       solver.AddEdge(S, v, supply[v], 0);
-      total_supply += supply[v];
     } else if (supply[v] < 0) {
       solver.AddEdge(v, T, -supply[v], 0);
     }

@@ -34,6 +34,7 @@ from poseidon_tpu.graph.builder import GraphMeta
 from poseidon_tpu.graph.network import FlowNetwork
 from poseidon_tpu.ops.dense_auction import (
     CostDomainTooLarge,
+    DenseMemoryTooLarge,
     DenseState,
     build_dense_instance,
     solve_dense,
@@ -47,6 +48,16 @@ from poseidon_tpu.ops.transport import (
 )
 
 log = logging.getLogger(__name__)
+
+# Small-instance dispatch thresholds. Below this size the ~ms-scale TPU
+# per-launch dispatch floor exceeds the whole subprocess-oracle solve
+# (PERF.md "config 1": a 100-task solve is ~1 round of real work but
+# pays the full launch floor; measured crossover ~1k tasks, widening
+# with machine count because the oracle's graph grows with M). The
+# bounds are conservative — between them and the crossover the TPU path
+# merely ties.
+SMALL_INSTANCE_TASKS = 256
+SMALL_INSTANCE_MACHINES = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +85,7 @@ def solve_scheduling(
     warm: DenseState | None = None,
     oracle_fallback: bool = True,
     oracle_timeout_s: float = 1000.0,
+    small_to_oracle: bool = True,
 ) -> SolveOutcome:
     """Solve a priced scheduling network exactly; prefer the TPU kernel.
 
@@ -82,14 +94,34 @@ def solve_scheduling(
     solve re-settles at eps = 1 (the incremental path). Shape changes
     (cluster grew past a padding bucket) silently fall back to a cold
     solve.
+
+    ``small_to_oracle`` lets the dispatcher route instances under the
+    SMALL_INSTANCE_* thresholds straight to the subprocess oracle, where
+    the TPU per-launch floor exceeds the whole CPU solve. Differential
+    tests that specifically exercise the dense kernel pass False.
     """
     t0 = time.perf_counter()
+    # size dispatch BEFORE extraction: meta alone names the instance
+    # size, and paying even the (cheap) transportation extract on a
+    # path whose whole point is "the oracle solves this faster than
+    # any device overhead" would hand the comparison back
+    if (
+        small_to_oracle
+        and oracle_fallback
+        and warm is None
+        and 0 < len(meta.task_uids) <= SMALL_INSTANCE_TASKS
+        and len(meta.machine_names) <= SMALL_INSTANCE_MACHINES
+    ):
+        return _solve_on_oracle(
+            net, t0, why="small-instance", timeout_s=oracle_timeout_s
+        )
     try:
         inst = extract_instance(net, meta)
     except NotSchedulingShaped:
-        if not oracle_fallback:
-            raise
-        return _solve_on_oracle(net, t0, why="not-scheduling-shaped", timeout_s=oracle_timeout_s)
+        return _solve_general(
+            net, t0, oracle_fallback=oracle_fallback,
+            timeout_s=oracle_timeout_s,
+        )
 
     try:
         res, state = solve_transport_dense(inst, warm=warm)
@@ -97,6 +129,16 @@ def solve_scheduling(
         if not oracle_fallback:
             raise
         return _solve_on_oracle(net, t0, why="cost-domain", timeout_s=oracle_timeout_s)
+    except DenseMemoryTooLarge:
+        # the [Tp, Mp] table would blow the HBM budget: degrade loudly
+        # (the guard, not an OOM, decides) — same seam as cost-domain
+        log.warning(
+            "instance %dx%d exceeds the dense HBM budget; degrading "
+            "to oracle", inst.n_tasks, inst.n_machines,
+        )
+        if not oracle_fallback:
+            raise
+        return _solve_on_oracle(net, t0, why="memory-envelope", timeout_s=oracle_timeout_s)
     except ValueError:
         # defensive: an instance outside the kernel's envelope (e.g.
         # negative costs from a custom model) must degrade, not crash —
@@ -128,6 +170,52 @@ def solve_scheduling(
             f"{res.rounds} rounds) and oracle fallback is disabled"
         )
     return _solve_on_oracle(net, t0, why="uncertified", timeout_s=oracle_timeout_s)
+
+
+def _solve_general(
+    net: FlowNetwork, t0: float, *, oracle_fallback: bool,
+    timeout_s: float,
+) -> SolveOutcome:
+    """Non-taxonomy graphs (hand-written DIMACS, exotic topologies):
+    the exact general-graph JAX backend (``ops/cost_scaling``, the
+    device-side cs2 analog), with the C++ oracle only on its guards —
+    the int32 excess-wrap precheck, a blown sweep fuse, or an instance
+    the forcing-arc construction reports capacity-infeasible. The
+    reference solves every graph through the same external-solver seam
+    (scheduler_bridge.cc:170-172); this is that seam's general lane.
+    """
+    import jax
+
+    from poseidon_tpu.ops.cost_scaling import (
+        solve_cost_scaling,
+        solution_cost,
+    )
+
+    try:
+        res = solve_cost_scaling(net)
+        conv, feas = jax.device_get((res.converged, res.feasible))
+        if bool(conv) and bool(feas):
+            return SolveOutcome(
+                flows=np.asarray(jax.device_get(res.flows), np.int32),
+                cost=solution_cost(net, res),
+                backend="cost_scaling",
+                exact=True,
+                solve_ms=(time.perf_counter() - t0) * 1000,
+                state=None,
+                instance=None,
+            )
+        why = "general-unconverged" if not bool(conv) else "general-infeasible"
+    except ValueError as e:
+        # the excess-wrap precheck (capacities too large for the int32
+        # accumulators) — a documented guard, not a kernel bug
+        log.warning("general JAX backend rejected the graph: %s", e)
+        why = "general-guard"
+    if not oracle_fallback:
+        raise RuntimeError(
+            f"general JAX solve failed ({why}) and oracle fallback is "
+            f"disabled"
+        )
+    return _solve_on_oracle(net, t0, why=why, timeout_s=timeout_s)
 
 
 def _solve_on_oracle(

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Profile one config-4 (trace replay, 12k machines) resident round.
+
+Breaks the device chain into per-stage timings WITH a block after each
+stage — wall times here include the tunnel's completion-visibility
+latency per sync, so they are attribution, not production numbers (the
+production round pipelines the whole chain into one sync). Run on the
+real TPU:  python scripts/profile_config4.py
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import dataclasses as dc
+
+    import jax
+
+    from poseidon_tpu.bridge import SchedulerBridge
+    from poseidon_tpu.cluster import TaskPhase
+    from poseidon_tpu.graph.builder import FlowGraphBuilder
+    from poseidon_tpu.models.costs import build_cost_inputs_host
+    from poseidon_tpu.ops import resident as rz
+    from poseidon_tpu.ops.resident import (
+        ResidentSolver,
+        _finalize,
+        _jitted_model,
+        _redensify,
+        pad_topology,
+    )
+    from poseidon_tpu.ops.dense_auction import solve_dense
+    from poseidon_tpu.ops.transport import extract_topology
+    from poseidon_tpu.synth import config4_trace_replay
+
+    print(f"device = {jax.devices()[0]}", file=sys.stderr)
+
+    machines, stream = config4_trace_replay(12_000, seed=0)
+    bridge = SchedulerBridge(cost_model="quincy")
+    bridge.observe_nodes(machines)
+    solver: ResidentSolver = bridge.solver
+
+    def step(rnd):
+        new_tasks, done = next(stream)
+        done_set = set(done)
+        snapshot = [
+            dc.replace(t, phase=TaskPhase.SUCCEEDED)
+            if t.uid in done_set else t
+            for t in bridge.tasks.values()
+        ] + new_tasks
+        bridge.observe_pods(snapshot)
+        result = bridge.run_scheduler()
+        for uid, m in result.bindings.items():
+            bridge.confirm_binding(uid, m)
+        return result
+
+    # two production rounds to warm compiles + warm state
+    for rnd in range(3):
+        r = step(rnd)
+        print(
+            f"warm round {rnd}: solve={r.stats.solve_ms:.1f} "
+            f"total={r.stats.total_ms:.1f} backend={r.stats.backend}",
+            file=sys.stderr,
+        )
+
+    # now run instrumented rounds: same chain, block per stage
+    for rnd in range(3, 8):
+        new_tasks, done = next(stream)
+        done_set = set(done)
+        snapshot = [
+            dc.replace(t, phase=TaskPhase.SUCCEEDED)
+            if t.uid in done_set else t
+            for t in bridge.tasks.values()
+        ] + new_tasks
+        bridge.observe_pods(snapshot)
+
+        cluster = bridge.cluster_state()
+        pending = cluster.pending()
+        t0 = time.perf_counter()
+        arrays, meta = FlowGraphBuilder().build_arrays(cluster)
+        t_build = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        solver._e_floor = max(solver._e_floor, 16)
+        from poseidon_tpu.graph.network import pad_bucket
+
+        E = pad_bucket(max(meta.n_arcs, 1), minimum=solver._e_floor)
+        inputs_host = build_cost_inputs_host(
+            E, meta,
+            task_cpu_milli=np.array(
+                [int(t.cpu_request * 1000) for t in pending]
+            ),
+            task_mem_kb=np.array(
+                [t.memory_request_kb for t in pending]
+            ),
+            task_usage=bridge.knowledge.task_cpu_usage(
+                [t.uid for t in pending]
+            ),
+            machine_load=bridge.knowledge.machine_load(
+                [m.name for m in cluster.machines]
+            ),
+            machine_mem_free=bridge.knowledge.machine_mem_free(
+                [m.name for m in cluster.machines]
+            ),
+        )
+        topo = extract_topology(
+            meta, arrays["src"], arrays["dst"], arrays["cap"]
+        )
+        dt_host = pad_topology(
+            topo, t_min=solver._t_floor, m_min=solver._m_floor
+        )
+        t_prep = time.perf_counter() - t0
+
+        T, P = topo.n_tasks, topo.max_prefs
+        smax = min(
+            pad_bucket(max(int(topo.slots.max(initial=1)), 1), minimum=1),
+            dt_host.arc_unsched.shape[0],
+        )
+
+        t0 = time.perf_counter()
+        inputs_dev, dt = jax.device_put((inputs_host, dt_host))
+        jax.block_until_ready(dt.slots)
+        t_upload = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        cost = _jitted_model("quincy")(inputs_dev)
+        jax.block_until_ready(cost)
+        t_price = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        with jax.enable_x64(True):
+            dev, domain_ok, pc_s, ra_s = _redensify(
+                dt, cost, n_prefs=P, smax=smax
+            )
+        jax.block_until_ready(dev.c)
+        t_dens = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        state = solve_dense(dev, warm=solver._warm, alpha=solver.alpha)
+        jax.block_until_ready(state.asg)
+        t_solve = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        with jax.enable_x64(True):
+            ch_dev, primal = _finalize(dev, dt, pc_s, ra_s, state.asg)
+        jax.block_until_ready(ch_dev)
+        t_fin = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        out = jax.device_get((
+            state.asg, ch_dev, state.converged, state.rounds,
+            state.phases, primal, domain_ok,
+        ))
+        t_fetch = time.perf_counter() - t0
+
+        solver._warm = state
+        rounds = int(out[3])
+        # apply bindings so the next profiled round is realistic
+        Mp = dt_host.arc_m2s.shape[0]
+        asg = np.asarray(out[0][:T], np.int32)
+        asg = np.where(
+            (asg >= 0) & (asg < Mp) & (asg < topo.n_machines), asg, -1
+        )
+        names = meta.machine_names
+        for uid, m in zip(meta.task_uids, asg):
+            if m >= 0:
+                bridge.confirm_binding(uid, names[m])
+        bridge.round_num += 1
+
+        shapes = (
+            f"T={T} Tp={dt_host.arc_unsched.shape[0]} "
+            f"Mp={dt_host.slots.shape[0]} E={E} P={P} smax={smax}"
+        )
+        print(
+            f"round {rnd}: {shapes} auction_rounds={rounds}\n"
+            f"  build={t_build * 1e3:7.1f} prep={t_prep * 1e3:7.1f} "
+            f"upload={t_upload * 1e3:7.1f} price={t_price * 1e3:7.1f}\n"
+            f"  redensify={t_dens * 1e3:7.1f} solve={t_solve * 1e3:7.1f} "
+            f"finalize={t_fin * 1e3:7.1f} fetch={t_fetch * 1e3:7.1f}",
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

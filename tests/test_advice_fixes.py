@@ -247,7 +247,7 @@ class TestAgingStaysInsideAuctionDomain:
         meta.task_wait[:] = 500  # way past WAIT_CAP
         net = price(net, meta, "quincy", cluster)
         build_dense_instance(extract_instance(net, meta))  # no raise
-        outcome = solve_scheduling(net, meta)
+        outcome = solve_scheduling(net, meta, small_to_oracle=False)
         assert outcome.backend == "dense_auction"
 
 

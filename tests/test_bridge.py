@@ -128,7 +128,12 @@ class TestLifecycle:
         assert r2.stats.cost >= r1.stats.cost
 
     def test_warm_state_reused_across_rounds(self):
-        bridge = SchedulerBridge(cost_model="quincy")
+        # small_to_oracle off: warm on-HBM state only exists on the
+        # dense path, which the production dispatcher skips for a
+        # 4-machine/6-pod toy cluster
+        bridge = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False
+        )
         bridge.observe_nodes(_machines(4))
         bridge.observe_pods(_pods(6))
         r1 = bridge.run_scheduler()

@@ -59,7 +59,7 @@ class TestDifferential:
                 stats["converged"] += 1
                 assert res.cost == o.cost, (model, M, T)
             else:
-                out = solve_scheduling(net, meta)
+                out = solve_scheduling(net, meta, small_to_oracle=False)
                 assert out.exact and out.cost == o.cost, (model, M, T)
             if model in ("trivial", "quincy"):
                 assert res.converged, (model, M, T, res.rounds)
@@ -246,7 +246,7 @@ class TestFrontDoor:
         cluster = random_cluster(rng, 15, 70)
         net, meta = FlowGraphBuilder().build(cluster)
         net = price(net, meta, "quincy", cluster)
-        out = solve_scheduling(net, meta)
+        out = solve_scheduling(net, meta, small_to_oracle=False)
         o = solve_oracle(net, algorithm="cost_scaling")
         assert out.backend == "dense_auction"
         assert out.exact and out.cost == o.cost
@@ -254,7 +254,24 @@ class TestFrontDoor:
         out2 = solve_scheduling(net, meta, warm=out.state)
         assert out2.cost == o.cost
 
-    def test_solve_scheduling_oracle_fallback_on_shape(self):
+    def test_small_instance_routes_to_oracle(self):
+        """The dispatcher sends tiny instances to the subprocess oracle
+        (the TPU launch floor exceeds the whole solve there; round-4
+        verdict Next #8) — exactly, and only when allowed to."""
+        rng = np.random.default_rng(22)
+        cluster = random_cluster(rng, 10, 60)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "trivial", cluster)
+        out = solve_scheduling(net, meta)
+        assert out.backend == "oracle:small-instance"
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert out.exact and out.cost == o.cost
+
+    def test_general_graph_solves_on_jax_backend(self):
+        """A hand-written DIMACS graph (outside the builder taxonomy)
+        solves on the general-graph JAX cost-scaling backend, exact vs
+        the oracle (round-4 verdict Next #9 — the general backends are
+        front-door lanes, not test-only passengers)."""
         from poseidon_tpu.graph.dimacs import read_dimacs
 
         net = read_dimacs(
@@ -269,8 +286,9 @@ class TestFrontDoor:
             ClusterState(machines=[], tasks=[])
         )
         out = solve_scheduling(net, meta)
-        assert out.backend.startswith("oracle:")
-        assert out.cost == 12
+        assert out.backend == "cost_scaling"
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert out.cost == o.cost == 12
 
 
 class TestPlacementPaths:
@@ -281,7 +299,7 @@ class TestPlacementPaths:
         cluster = random_cluster(rng, 14, 90)
         net, meta = FlowGraphBuilder().build(cluster)
         net = price(net, meta, "quincy", cluster)
-        out = solve_scheduling(net, meta)
+        out = solve_scheduling(net, meta, small_to_oracle=False)
         assert out.assignment is not None
         direct = {
             uid: (meta.machine_names[m] if m >= 0 else None)

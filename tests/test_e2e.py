@@ -56,7 +56,12 @@ class TestEndToEndSlice:
             assert nodes[0].rack.startswith("rack")
             assert pods[0].cpu_request == 0.25
 
-            bridge = SchedulerBridge(cost_model="quincy")
+            # small_to_oracle off: this slice specifically exercises
+            # the TPU dense path end to end (the production dispatcher
+            # would route a 10-node/100-pod cluster to the oracle)
+            bridge = SchedulerBridge(
+                cost_model="quincy", small_to_oracle=False
+            )
             bridge.observe_nodes(nodes)
             bridge.observe_pods(pods)
 

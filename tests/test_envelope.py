@@ -1,0 +1,115 @@
+"""Dense-memory envelope guard (round-4 verdict, Next #6).
+
+The dense [Tp, Mp] cost table is the solve's dominant HBM footprint.
+Nothing used to check it before ``_densify``/``_redensify`` — a 64k-task
+x 16k-machine cluster would OOM mid-solve instead of degrading. Now
+``check_table_budget`` gates every densify entry (front door, resident
+round, what-if batch) and oversize instances fall back to the oracle
+loudly, like the cost-domain guard.
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.graph.builder import FlowGraphBuilder
+from poseidon_tpu.ops import dense_auction
+from poseidon_tpu.ops.dense_auction import (
+    DenseMemoryTooLarge,
+    check_table_budget,
+)
+from poseidon_tpu.ops.resident import ResidentSolver
+from poseidon_tpu.oracle import solve_oracle
+from poseidon_tpu.solver import solve_scheduling
+
+from tests.helpers import price, random_cluster
+
+
+class TestTableBudget:
+    def test_flagship_fits(self):
+        # the BASELINE flagship table is [10240, 1024] i32 = 40 MiB
+        check_table_budget(10240, 1024)
+
+    def test_64k_x_16k_exceeds(self):
+        # a 64k-task x 16k-machine cluster is a 4 GiB table — over the
+        # 2 GiB default budget; must raise, not OOM later
+        with pytest.raises(DenseMemoryTooLarge):
+            check_table_budget(65536, 16384)
+
+    def test_what_if_batch_scales_with_variants(self):
+        check_table_budget(4096, 1024, n_variants=64)   # 1 GiB: fits
+        with pytest.raises(DenseMemoryTooLarge):
+            check_table_budget(16384, 1024, n_variants=64)  # 4 GiB
+
+
+class TestFrontDoorDegrade:
+    def test_solve_scheduling_degrades_to_oracle(self, monkeypatch):
+        monkeypatch.setattr(
+            dense_auction, "DENSE_TABLE_BUDGET_BYTES", 1024
+        )
+        cluster = random_cluster(np.random.default_rng(41), 6, 30)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "trivial", cluster)
+        out = solve_scheduling(net, meta, small_to_oracle=False)
+        assert out.backend == "oracle:memory-envelope"
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert out.exact and out.cost == o.cost
+
+    def test_raises_when_fallback_disabled(self, monkeypatch):
+        monkeypatch.setattr(
+            dense_auction, "DENSE_TABLE_BUDGET_BYTES", 1024
+        )
+        cluster = random_cluster(np.random.default_rng(43), 6, 30)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "trivial", cluster)
+        with pytest.raises(DenseMemoryTooLarge):
+            solve_scheduling(
+                net, meta, oracle_fallback=False, small_to_oracle=False
+            )
+
+
+class TestResidentDegrade:
+    def _round(self, cluster, solver):
+        arrays, meta = FlowGraphBuilder().build_arrays(cluster)
+        pending = cluster.pending()
+        return solver.run_round(
+            arrays, meta, cost_model="trivial",
+            cost_input_kwargs=dict(
+                task_cpu_milli=np.array(
+                    [int(t.cpu_request * 1000) for t in pending]
+                ),
+                task_mem_kb=np.array(
+                    [t.memory_request_kb for t in pending]
+                ),
+            ),
+        )
+
+    def test_resident_round_degrades_loudly(self, monkeypatch):
+        monkeypatch.setattr(
+            dense_auction, "DENSE_TABLE_BUDGET_BYTES", 1024
+        )
+        cluster = random_cluster(np.random.default_rng(47), 6, 30)
+        solver = ResidentSolver(small_to_oracle=False)
+        out = self._round(cluster, solver)
+        assert out.backend == "oracle:memory-envelope"
+        assert out.converged
+        assert (out.assignment >= 0).any()
+        assert solver.warm is None  # stale warm state dropped
+
+    def test_what_if_guard(self, monkeypatch):
+        from poseidon_tpu.ops.batch import solve_what_if
+        from poseidon_tpu.ops.transport import extract_instance
+
+        cluster = random_cluster(np.random.default_rng(49), 6, 30)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "trivial", cluster)
+        inst = extract_instance(net, meta)
+        # budget admits one table but not 64 of them
+        from poseidon_tpu.graph.network import pad_bucket
+
+        tp = pad_bucket(inst.n_tasks)
+        mp = pad_bucket(inst.n_machines)
+        monkeypatch.setattr(
+            dense_auction, "DENSE_TABLE_BUDGET_BYTES", tp * mp * 4 * 8
+        )
+        with pytest.raises(DenseMemoryTooLarge):
+            solve_what_if(inst, n_variants=64, seed=1)

@@ -8,7 +8,7 @@ from poseidon_tpu.graph.network import FlowNetwork
 from poseidon_tpu.oracle import solve_oracle
 from poseidon_tpu.oracle.oracle import OracleInfeasible
 
-ALGOS = ["ssp", "cost_scaling"]
+ALGOS = ["ssp", "cost_scaling", "cs2"]
 
 
 def check_flow(net: FlowNetwork, flows: np.ndarray) -> None:

@@ -14,7 +14,10 @@ from tests.helpers import price, random_cluster
 
 
 def _round(cluster, model="quincy", solver=None):
-    solver = solver or ResidentSolver()
+    # small_to_oracle off: these tests exercise the dense device chain
+    # on deliberately small instances (the production dispatcher would
+    # route them to the oracle)
+    solver = solver or ResidentSolver(small_to_oracle=False)
     arrays, meta = FlowGraphBuilder().build_arrays(cluster)
     pending = cluster.pending()
     out = solver.run_round(

@@ -1,13 +1,22 @@
-"""Batched what-if scheduling: vmap over perturbed instances.
+"""Batched what-if scheduling: N perturbed variants, one call, one sync.
 
-BASELINE config 5: solve 64 cost-model variants of the same cluster in
-ONE compiled program — "what would placement look like if these costs
-shifted" — a capability the reference's architecture cannot express at
-all (its solver seam is one fork/exec of a CPU binary per instance,
-deploy/poseidon.cfg:8-10). Here the dense-auction kernel is ``vmap``-ed
-over the leading batch axis of the cost tables; every variant runs the
-full eps ladder in lockstep on device, so amortized per-instance time
-is a fraction of a single solve.
+BASELINE config 5: solve 64 cost-model variants of the same cluster —
+"what would placement look like if these costs shifted" — against the
+reference's architecture of one solver fork/exec per instance
+(deploy/poseidon.cfg:8-10). Variant construction is one vmapped
+program; variant SOLVES are independent pipelined dispatches of the
+single-instance kernel with one batched fetch at the end.
+
+Why not vmap the solves too? Measured (1k machines x 4k tasks, x64):
+the vmapped lockstep ladder ran ~56 ms/instance — every variant drags
+through every other variant's phase boundaries, whose dense [B, Tp,
+Mp] passes then run batch-wide — vs ~7 ms/instance for pipelined
+independent solves (the single-instance compute), an ~8x difference.
+The batching win is amortizing the host sync and sharing the topology
+upload, not locksteping the eps ladder. (An earlier revision of this
+module claimed the lockstep form made per-instance time "a fraction of
+a single solve"; that was wrong at spec scale and is retracted —
+bench.py config 5 records the measured economics.)
 
 Only cost-side arrays (c, u, w, dgen) vary per variant; topology
 (slots, task_valid) is shared. Perturbations are deterministic per
@@ -45,32 +54,39 @@ class BatchResult:
 
 
 @partial(jax.jit, static_argnames=("smax", "alpha", "max_rounds"))
-def _solve_batch(c, u, w, dgen, cmax, s, task_valid, scale,
-                 smax, alpha, max_rounds):
-    Tp, Mp = c.shape[1], c.shape[2]
+def _solve_variant(c, u, w, dg, cm, b, s, task_valid, scale,
+                   smax, alpha, max_rounds):
+    """Variant ``b``'s full certified solve + exact objective. Compiled
+    once over the stacked tables (the slice happens INSIDE the program
+    — eager per-variant slicing cost 4 extra dispatches each);
+    dispatched per variant back-to-back with no host syncs between —
+    the caller fetches all variants' results in one device_get."""
+    c1 = jax.lax.dynamic_index_in_dim(c, b, keepdims=False)
+    u1 = jax.lax.dynamic_index_in_dim(u, b, keepdims=False)
+    w1 = jax.lax.dynamic_index_in_dim(w, b, keepdims=False)
+    dg1 = jax.lax.dynamic_index_in_dim(dg, b, keepdims=False)
+    cm1 = jax.lax.dynamic_index_in_dim(cm, b, keepdims=False)
+    Tp, Mp = c1.shape
 
-    def one(c1, u1, w1, dg1, cm1):
-        dev = DenseInstance(
-            c=c1, u=u1, w=w1, dgen=dg1, s=s, task_valid=task_valid,
-            scale=scale, cmax=cm1, smax=smax,
-        )
-        asg0, lvl0, floor0, eps0 = cold_start(dev, alpha)
-        asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
-            dev, asg0, lvl0, floor0, eps0, alpha=alpha,
-            max_rounds=max_rounds, smax=smax, analytic_init=True,
-        )
-        # exact per-variant objective from the assignment
-        on_m = (asg >= 0) & (asg < Mp)
-        c_asg = jnp.take_along_axis(
-            c1, jnp.clip(asg, 0, Mp - 1)[:, None], axis=1
-        )[:, 0]
-        per_task = jnp.where(on_m, c_asg, jnp.where(asg == Mp, u1, 0))
-        cost = jnp.sum(
-            jnp.where(task_valid, per_task, 0).astype(jnp.int64)
-        )
-        return cost, converged, asg, rounds
-
-    return jax.vmap(one)(c, u, w, dgen, cmax)
+    dev = DenseInstance(
+        c=c1, u=u1, w=w1, dgen=dg1, s=s, task_valid=task_valid,
+        scale=scale, cmax=cm1, smax=smax,
+    )
+    asg0, lvl0, floor0, eps0 = cold_start(dev, alpha)
+    asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
+        dev, asg0, lvl0, floor0, eps0, alpha=alpha,
+        max_rounds=max_rounds, smax=smax, analytic_init=True,
+    )
+    # exact per-variant objective from the assignment
+    on_m = (asg >= 0) & (asg < Mp)
+    c_asg = jnp.take_along_axis(
+        c1, jnp.clip(asg, 0, Mp - 1)[:, None], axis=1
+    )[:, 0]
+    per_task = jnp.where(on_m, c_asg, jnp.where(asg == Mp, u1, 0))
+    cost = jnp.sum(
+        jnp.where(task_valid, per_task, 0).astype(jnp.int64)
+    )
+    return cost, converged, asg, rounds
 
 
 @partial(jax.jit, static_argnames=("n_variants", "magnitude_pct"))
@@ -174,15 +190,22 @@ def solve_what_if(
         c, u, w, dg, cmax = perturb_costs(
             dev, n_variants, seed, magnitude_pct=magnitude_pct
         )
-        cost, conv, asg, rounds = _solve_batch(
-            c, u, w, dg, cmax, dev.s, dev.task_valid, dev.scale,
-            smax=dev.smax, alpha=alpha, max_rounds=max_rounds,
-        )
+        outs = [
+            _solve_variant(
+                c, u, w, dg, cmax, jnp.int32(b), dev.s,
+                dev.task_valid, dev.scale, smax=dev.smax, alpha=alpha,
+                max_rounds=max_rounds,
+            )
+            for b in range(n_variants)
+        ]
     T = inst.n_tasks
-    Mp = dev.c.shape[1]
-    # one batched fetch: each separate device_get pays ~95 ms of
-    # tunnel-visibility latency on this environment
-    cost, conv, asg, rounds = jax.device_get((cost, conv, asg, rounds))
+    # one batched fetch for ALL variants: each separate device_get
+    # pays this environment's ~100 ms-per-sync charge
+    fetched = jax.device_get(outs)
+    cost = np.stack([f[0] for f in fetched])
+    conv = np.stack([f[1] for f in fetched])
+    asg = np.stack([f[2] for f in fetched])
+    rounds = np.stack([f[3] for f in fetched])
     asg_np = np.asarray(asg, np.int32)[:, :T]
     asg_np = np.where(
         (asg_np >= 0) & (asg_np < inst.n_machines), asg_np, -1

@@ -13,10 +13,12 @@ device, downloaded them (`net.to_host()`), rebuilt the dense instance on
 host, and re-uploaded it — 5+ tunnel crossings per round at ~95 ms each,
 which is where trace-replay's 950 ms solve_p50 went. The resident round
 does exactly ONE batched ``jax.device_put`` (pricing inputs + topology
-index maps) and ONE batched ``jax.device_get`` (assignment + certificate),
-with everything between — cost model, densify, eps-ladder auction,
-channel/objective extraction — dispatched device-side with no host sync
-except a ``block_until_ready`` (sub-ms on the tunnel).
+index maps), ONE fused compiled program (``_resident_chain``: cost
+model → densify → eps-ladder auction → channel/objective finalize),
+and ONE batched ``jax.device_get`` (assignment + certificate). That
+single readback is the round's one unavoidable host sync — a flat
+~100 ms on this environment's link (measured, ``bench.bench_tunnel``),
+~us on directly-attached hardware.
 
 Fallbacks mirror ``solve_scheduling``: a cost table outside the auction's
 integer domain (checked on device, read back with the result batch) or an
@@ -47,8 +49,9 @@ from poseidon_tpu.ops.dense_auction import (
     DenseMemoryTooLarge,
     DenseState,
     _densify,
+    _solve,
     check_table_budget,
-    solve_dense,
+    default_fuse,
 )
 from poseidon_tpu.ops.transport import (
     CH_CLUSTER,
@@ -245,6 +248,71 @@ def _jitted_model(name: str):
     return jitted
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "model_fn", "n_prefs", "smax", "alpha", "max_rounds",
+        "warm_start",
+    ),
+)
+def _resident_chain(
+    dt: DenseTopology,
+    inputs_dev,
+    warm_asg,
+    warm_lvl,
+    warm_floor,
+    *,
+    model_fn,
+    n_prefs: int,
+    smax: int,
+    alpha: int,
+    max_rounds: int,
+    warm_start: bool,
+):
+    """The WHOLE resident round as ONE compiled program: cost model →
+    densify → eps-ladder auction → channel/objective finalize.
+
+    Fusing replaces the previous chain of four separately-dispatched
+    programs (model, redensify, solve, finalize) with one: per
+    ``bench.bench_tunnel``'s link model, each async dispatch costs
+    ~1 ms here (vs ~us attached), so the fusion saves ~3 ms/round on
+    this link and is strictly fewer launches on any hardware. The
+    round's dominant cost on this environment — the flat ~100 ms
+    per-sync charge on the single result readback — is unaffected by
+    program structure and is reported separately by the bench.
+
+    ``model_fn``/``warm_start`` are static: one compiled variant per
+    (cost model, cold/warm) pair per shape bucket. When
+    ``warm_start`` is False the warm_* arrays are ignored (pass
+    zeros).
+    """
+    cost = model_fn(inputs_dev)
+    dev, domain_ok, pc_s, ra_s = _redensify(
+        dt, cost, n_prefs=n_prefs, smax=smax
+    )
+    Tp, Mp = dev.c.shape
+    if warm_start:
+        asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
+            dev, warm_asg, warm_lvl, warm_floor, jnp.int32(1),
+            alpha=alpha, max_rounds=max_rounds, smax=smax,
+            analytic_init=False,
+        )
+    else:
+        asg0 = jnp.where(dev.task_valid, -1, Mp).astype(I32)
+        lvl0 = jnp.zeros(Tp, I32)
+        floor0 = jnp.zeros(Mp, I32)
+        eps0 = jnp.maximum(dev.cmax // alpha, 1)
+        asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
+            dev, asg0, lvl0, floor0, eps0, alpha=alpha,
+            max_rounds=max_rounds, smax=smax, analytic_init=True,
+        )
+    ch, primal = _finalize(dev, dt, pc_s, ra_s, asg)
+    # flat tuple out (DenseState is not a registered pytree); the
+    # caller reassembles the warm handle host-side
+    return (asg, lvl, floor, gap, converged, rounds, phases, ch,
+            primal, domain_ok)
+
+
 @dataclasses.dataclass
 class ResidentOutcome:
     """One resident round's result, fully host-side."""
@@ -400,32 +468,50 @@ class ResidentSolver:
         )
         timings["prep_ms"] = (time.perf_counter() - t0) * 1000
 
-        # ---- upload + device chain + ONE sync ------------------------
-        # No intermediate block_until_ready: on this environment every
-        # host synchronization costs ~90 ms of tunnel-visibility
-        # latency, and blocking after the upload and after the solve
-        # (purely for per-phase timing attribution) tripled the round's
-        # wall time. The whole chain pipelines into the single
-        # device_get below; ``solve_ms`` therefore covers upload +
-        # pricing + densify + solve + finalize + completion, and
-        # ``upload_ms``/``fetch_ms`` record only dispatch/transfer
-        # bookkeeping around it.
+        # ---- upload + ONE fused program + ONE sync -------------------
+        # The whole device round (cost model → densify → solve →
+        # finalize) is a single compiled program (``_resident_chain``):
+        # this environment charges a flat per-program-execution floor
+        # (~12-17 ms measured, see bench.bench_tunnel), so the previous
+        # four-program chain paid it four times per round. No
+        # intermediate block_until_ready either — the program pipelines
+        # into the single device_get below; ``solve_ms`` covers
+        # dispatch + execution + completion.
         t0 = time.perf_counter()
         inputs_dev, dt = jax.device_put((inputs_host, dt_host))
         timings["upload_ms"] = (time.perf_counter() - t0) * 1000
 
-        t0 = time.perf_counter()
-        cost = _jitted_model(cost_model)(inputs_dev)
-        with jax.enable_x64(True):
-            dev, domain_ok, pc_s, ra_s = _redensify(
-                dt, cost, n_prefs=P, smax=smax
-            )
-        state = solve_dense(
-            dev, warm=self._warm, alpha=self.alpha,
-            max_rounds=self.max_rounds,
+        Tp = dt_host.arc_unsched.shape[0]
+        Mp = dt_host.slots.shape[0]
+        warm = self._warm
+        if warm is not None and (
+            warm.asg.shape[0] != Tp or warm.floor.shape[0] != Mp
+        ):
+            warm = None  # cluster outgrew its padding bucket
+        max_rounds = (
+            self.max_rounds if self.max_rounds is not None
+            else default_fuse()
         )
+        model_fn = get_cost_model(cost_model)
+        zeros_t = jnp.zeros(Tp, I32)
+        zeros_m = jnp.zeros(Mp, I32)
+
+        t0 = time.perf_counter()
         with jax.enable_x64(True):
-            ch_dev, primal = _finalize(dev, dt, pc_s, ra_s, state.asg)
+            (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d, phases_d,
+             ch_dev, primal, domain_ok) = _resident_chain(
+                dt, inputs_dev,
+                warm.asg if warm is not None else zeros_t,
+                warm.lvl if warm is not None else zeros_t,
+                warm.floor if warm is not None else zeros_m,
+                model_fn=model_fn, n_prefs=P, smax=smax,
+                alpha=self.alpha, max_rounds=max_rounds,
+                warm_start=warm is not None,
+            )
+        state = DenseState(
+            asg=asg_d, lvl=lvl_d, floor=floor_d, gap=gap_d,
+            converged=conv_d, rounds=rounds_d, phases=phases_d,
+        )
         asg_np, ch_np, conv, rounds, phases, primal_np, dom_ok = (
             jax.device_get((
                 state.asg, ch_dev, state.converged, state.rounds,
@@ -437,21 +523,28 @@ class ResidentSolver:
 
         if not bool(dom_ok):
             self._warm = None
+            cost = _jitted_model(cost_model)(inputs_dev)
             return self._oracle_round(
                 arrays, meta, topo, cost, timings, why="cost-domain"
             )
-        if not bool(conv) and self._warm is not None:
+        if not bool(conv) and warm is not None:
             # stale warm start stranded the eps=1 settle: retry cold
             # (its solve + second download land in the same timing
             # columns — this round really does pay twice)
             self._warm = None
             t0 = time.perf_counter()
-            state = solve_dense(
-                dev, warm=None, alpha=self.alpha,
-                max_rounds=self.max_rounds,
-            )
             with jax.enable_x64(True):
-                ch_dev, primal = _finalize(dev, dt, pc_s, ra_s, state.asg)
+                (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
+                 phases_d, ch_dev, primal, _dom) = _resident_chain(
+                    dt, inputs_dev, zeros_t, zeros_t, zeros_m,
+                    model_fn=model_fn, n_prefs=P, smax=smax,
+                    alpha=self.alpha, max_rounds=max_rounds,
+                    warm_start=False,
+                )
+            state = DenseState(
+                asg=asg_d, lvl=lvl_d, floor=floor_d, gap=gap_d,
+                converged=conv_d, rounds=rounds_d, phases=phases_d,
+            )
             asg_np, ch_np, conv, rounds, phases, primal_np = (
                 jax.device_get((
                     state.asg, ch_dev, state.converged, state.rounds,
@@ -461,6 +554,7 @@ class ResidentSolver:
             timings["solve_ms"] += (time.perf_counter() - t0) * 1000
         if not bool(conv):
             self._warm = None
+            cost = _jitted_model(cost_model)(inputs_dev)
             return self._oracle_round(
                 arrays, meta, topo, cost, timings, why="uncertified"
             )

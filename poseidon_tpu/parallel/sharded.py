@@ -23,6 +23,17 @@ Machine-side state (slot table, floors, price aggregates) is
 replicated: it is O(M) ints, thousands of times smaller than the
 [T, M] cost table, so the ICI traffic per round is per-machine
 aggregates only.
+
+When width > 1 wins: the compiled program carries ~28 collectives per
+auction round (collective_account: 12 all-reduce + 16 all-gather of
+O(M) int32), ~4 KiB each at M = 1k. On real v5e ICI (~45 GB/s/link,
+~1 us/hop public figures) that is ~30-60 us/round of latency-dominated
+collective cost, while sharding the task axis saves (N-1)/N of the
+round's dense-pass bytes. Width 8 therefore wins once the per-round
+dense pass exceeds ~250 us — i.e. B x M >= ~50M int32 (B = bid window
+= max(1024, T/4)) — and loses below it. PERF.md "Sharding" multiplies
+this out: the 10k-task flagship fits one chip and SHOULD run width 1;
+a 100k-task x 12k-machine cluster is firmly in the width-8 win region.
 """
 
 from __future__ import annotations

@@ -271,14 +271,16 @@ def bench_config(
         return (time.perf_counter() - ta) * 1000, out
 
     with jax.enable_x64(True):
-        cs1 = jnp.stack([d.c for d in churned[:solve_reps]])
-        us1 = jnp.stack([d.u for d in churned[:solve_reps]])
+        # stack FIRST, then drop the per-rep originals, then slice the
+        # R-length view out of the 2R stack — peak HBM is 2R tables
+        # plus one R-table slice, not the 5R a naive
+        # stack-both-while-churned-lives ordering holds (flagship
+        # tables are 40 MB each; solve_reps=20 makes that gap ~1.6 GB)
         cs2_ = jnp.stack([d.c for d in churned])
         us2_ = jnp.stack([d.u for d in churned])
-        # the per-rep tables are now duplicated inside the stacks; drop
-        # the originals before solving or the section holds ~2x the
-        # HBM it needs (flagship: ~1.6 GB of 40 MB tables per copy)
         del churned
+        cs1 = cs2_[:solve_reps]
+        us1 = us2_[:solve_reps]
         _timed_scan(cs1, us1)     # compile R
         _timed_scan(cs2_, us2_)   # compile 2R
         t_r, out = _timed_scan(cs1, us1)
@@ -761,11 +763,13 @@ def main() -> int:
             "solve_warm_churn_scan_ms",
             flagship.get("solve_warm_churn_ms", flagship["solve_warm_ms"]),
         )
+        # field ORDER matters: drivers that keep only the TAIL of
+        # stdout (BENCH_r04.json did) must still see the headline
+        # scalars, so the bulky configs array goes first and the
+        # metric/value/vs_baseline summary goes last in the one line
         headline = {
-            "metric": "quincy_1k10k_warm_churn_solve_p50",
-            "value": value,
-            "unit": "ms",
-            "vs_baseline": round(flagship["oracle_ms"] / value, 2),
+            "configs": rows,
+            "tunnel": tunnel,
             "value_per_dispatch_ms": flagship.get("solve_warm_churn_ms"),
             "compute_ms_per_resolve": flagship.get(
                 "solve_warm_churn_compute_ms"
@@ -779,8 +783,10 @@ def main() -> int:
             and flagship.get("warm_churn_all_converged", True)
             and flagship.get("warm_churn_scan_converged", True),
             "device": str(backend),
-            "tunnel": tunnel,
-            "configs": rows,
+            "metric": "quincy_1k10k_warm_churn_solve_p50",
+            "value": value,
+            "unit": "ms",
+            "vs_baseline": round(flagship["oracle_ms"] / value, 2),
         }
     else:
         fallback = next((r for r in rows if not r.get("error")), None)
@@ -790,6 +796,8 @@ def main() -> int:
         ) if fallback else -1
         ora = fallback.get("oracle_ms") if fallback else None
         headline = {
+            "configs": rows,
+            "tunnel": tunnel,
             "metric": (
                 f"{fallback['config']}_solve_p50"
                 if fallback
@@ -800,8 +808,6 @@ def main() -> int:
             "vs_baseline": (
                 round(ora / val, 2) if ora and val and val > 0 else 0
             ),
-            "tunnel": tunnel,
-            "configs": rows,
         }
     print(json.dumps(headline), flush=True)
     return 0

@@ -177,7 +177,10 @@ def solve_what_if(
     alpha: int = 1024,
     max_rounds: int = 20_000,
 ) -> BatchResult:
-    """Solve ``n_variants`` perturbed copies of ``inst`` in one program."""
+    """Solve ``n_variants`` perturbed copies of ``inst``: vmapped
+    variant construction, independent pipelined per-variant solves, one
+    batched result fetch (see the module docstring for why the solves
+    are NOT vmapped)."""
     dev = build_dense_instance(inst)
     # the batch holds n_variants full cost tables at once — the memory
     # guard must scale with the batch, not just the single instance

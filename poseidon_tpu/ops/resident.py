@@ -20,10 +20,15 @@ single readback is the round's one unavoidable host sync — a flat
 ~100 ms on this environment's link (measured, ``bench.bench_tunnel``),
 ~us on directly-attached hardware.
 
-Fallbacks mirror ``solve_scheduling``: a cost table outside the auction's
-integer domain (checked on device, read back with the result batch) or an
-uncertified solve degrades to the C++ CPU oracle — one extra download of
-the priced arc table, only on the rare round that needs it.
+Fallbacks: a cost table outside the auction's integer domain (checked
+on device, read back with the result batch), a dense table beyond the
+HBM budget, or an uncertified solve degrades to the C++ CPU oracle —
+one extra download of the priced arc table, only on the rare round
+that needs it. One deliberate divergence from ``solve_scheduling``:
+non-taxonomy graphs go straight to the oracle here rather than the
+general JAX backend, because the resident path's whole value — warm
+on-HBM state across rounds — does not exist for them (the front door
+owns the general-graph JAX lane).
 """
 
 from __future__ import annotations
@@ -308,9 +313,11 @@ def _resident_chain(
         )
     ch, primal = _finalize(dev, dt, pc_s, ra_s, asg)
     # flat tuple out (DenseState is not a registered pytree); the
-    # caller reassembles the warm handle host-side
+    # caller reassembles the warm handle host-side. ``cost`` rides
+    # along so oracle-fallback paths reuse the priced arc table
+    # instead of re-running the model as a separate program.
     return (asg, lvl, floor, gap, converged, rounds, phases, ch,
-            primal, domain_ok)
+            primal, domain_ok, cost)
 
 
 @dataclasses.dataclass
@@ -470,12 +477,11 @@ class ResidentSolver:
 
         # ---- upload + ONE fused program + ONE sync -------------------
         # The whole device round (cost model → densify → solve →
-        # finalize) is a single compiled program (``_resident_chain``):
-        # this environment charges a flat per-program-execution floor
-        # (~12-17 ms measured, see bench.bench_tunnel), so the previous
-        # four-program chain paid it four times per round. No
-        # intermediate block_until_ready either — the program pipelines
-        # into the single device_get below; ``solve_ms`` covers
+        # finalize) is a single compiled program (``_resident_chain``,
+        # see its docstring for the measured dispatch economics). No
+        # intermediate block_until_ready — the program pipelines into
+        # the single device_get below, the round's one host sync (a
+        # flat ~100 ms on this link, ~us attached); ``solve_ms`` covers
         # dispatch + execution + completion.
         t0 = time.perf_counter()
         inputs_dev, dt = jax.device_put((inputs_host, dt_host))
@@ -499,7 +505,7 @@ class ResidentSolver:
         t0 = time.perf_counter()
         with jax.enable_x64(True):
             (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d, phases_d,
-             ch_dev, primal, domain_ok) = _resident_chain(
+             ch_dev, primal, domain_ok, cost_dev) = _resident_chain(
                 dt, inputs_dev,
                 warm.asg if warm is not None else zeros_t,
                 warm.lvl if warm is not None else zeros_t,
@@ -523,9 +529,8 @@ class ResidentSolver:
 
         if not bool(dom_ok):
             self._warm = None
-            cost = _jitted_model(cost_model)(inputs_dev)
             return self._oracle_round(
-                arrays, meta, topo, cost, timings, why="cost-domain"
+                arrays, meta, topo, cost_dev, timings, why="cost-domain"
             )
         if not bool(conv) and warm is not None:
             # stale warm start stranded the eps=1 settle: retry cold
@@ -535,11 +540,13 @@ class ResidentSolver:
             t0 = time.perf_counter()
             with jax.enable_x64(True):
                 (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
-                 phases_d, ch_dev, primal, _dom) = _resident_chain(
-                    dt, inputs_dev, zeros_t, zeros_t, zeros_m,
-                    model_fn=model_fn, n_prefs=P, smax=smax,
-                    alpha=self.alpha, max_rounds=max_rounds,
-                    warm_start=False,
+                 phases_d, ch_dev, primal, _dom, cost_dev) = (
+                    _resident_chain(
+                        dt, inputs_dev, zeros_t, zeros_t, zeros_m,
+                        model_fn=model_fn, n_prefs=P, smax=smax,
+                        alpha=self.alpha, max_rounds=max_rounds,
+                        warm_start=False,
+                    )
                 )
             state = DenseState(
                 asg=asg_d, lvl=lvl_d, floor=floor_d, gap=gap_d,
@@ -554,9 +561,8 @@ class ResidentSolver:
             timings["solve_ms"] += (time.perf_counter() - t0) * 1000
         if not bool(conv):
             self._warm = None
-            cost = _jitted_model(cost_model)(inputs_dev)
             return self._oracle_round(
-                arrays, meta, topo, cost, timings, why="uncertified"
+                arrays, meta, topo, cost_dev, timings, why="uncertified"
             )
 
         self._warm = state

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Adversarial fuse-exhaustion sweep (PERF.md "Known envelope").
+
+240 trials over the shape family that stresses the auction's round
+fuse: all six cost models x random 2-40 machines x 2-150 tasks,
+including heavy oversubscription (a 2-machine cluster offers ~20 seats
+against up to 150 tasks). Every converged solve must match the oracle
+exactly; every non-converged solve must be EXACT via the front door's
+fallback. Prints the exhaustion count — round 4 measured 3/240 (down
+from 19/240 before rotation tie-breaking); treat a rise as a
+regression in the auction's tie/termination behavior.
+
+Run: python scripts/adversarial_sweep.py  (on the TPU; ~10-20 min,
+mostly shape-bucket compiles)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from poseidon_tpu.graph.builder import FlowGraphBuilder
+    from poseidon_tpu.ops.dense_auction import solve_transport_dense
+    from poseidon_tpu.ops.transport import extract_instance
+    from poseidon_tpu.oracle import solve_oracle
+    from poseidon_tpu.solver import solve_scheduling
+
+    from tests.helpers import price, random_cluster
+
+    models = ["trivial", "quincy", "coco", "wharemap", "octopus", "random"]
+    trials = 240
+    exhausted: list[tuple] = []
+    wrong: list[tuple] = []
+    t0 = time.time()
+    rng = np.random.default_rng(20260730)
+    for trial in range(trials):
+        model = models[trial % len(models)]
+        M = int(rng.integers(2, 40))
+        T = int(rng.integers(2, 150))
+        cluster = random_cluster(rng, M, T)
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, model, cluster)
+        inst = extract_instance(net, meta)
+        res, _ = solve_transport_dense(inst)
+        o = solve_oracle(net, algorithm="cost_scaling")
+        if res.converged:
+            if res.cost != o.cost:
+                wrong.append((trial, model, M, T, res.cost, o.cost))
+        else:
+            exhausted.append((trial, model, M, T))
+            out = solve_scheduling(net, meta, small_to_oracle=False)
+            if out.cost != o.cost:
+                wrong.append((trial, model, M, T, out.cost, o.cost))
+        if (trial + 1) % 24 == 0:
+            print(
+                f"{trial + 1}/{trials}: exhausted={len(exhausted)} "
+                f"wrong={len(wrong)} ({time.time() - t0:.0f}s)",
+                file=sys.stderr, flush=True,
+            )
+    print(f"exhausted {len(exhausted)}/{trials}: {exhausted}")
+    print(f"wrong {len(wrong)}: {wrong}")
+    return 1 if wrong else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,32 @@ class TestTableBudget:
         with pytest.raises(DenseMemoryTooLarge):
             check_table_budget(16384, 1024, n_variants=64)  # 4 GiB
 
+    def test_synthetic_60k_x_16k_instance_falls_back(self):
+        """A real 60k-task x 16k-machine instance, end to end through
+        the builder and extraction: the guard fires BEFORE any device
+        allocation (the ~4 GiB padded table never exists), and with
+        the fallback disabled the front door surfaces the typed error
+        instead of OOMing."""
+        from poseidon_tpu.graph.builder import FlowGraphBuilder
+        from poseidon_tpu.models import build_cost_inputs, get_cost_model
+        from poseidon_tpu.ops.dense_auction import build_dense_instance
+        from poseidon_tpu.ops.transport import extract_instance
+        from poseidon_tpu.synth import make_synthetic_cluster
+
+        cluster = make_synthetic_cluster(
+            16_000, 60_000, seed=0, prefs_per_task=0
+        )
+        net, meta = FlowGraphBuilder().build(cluster)
+        inputs = build_cost_inputs(net, meta)
+        net = net.with_costs(get_cost_model("trivial")(inputs))
+        inst = extract_instance(net, meta)
+        with pytest.raises(DenseMemoryTooLarge):
+            build_dense_instance(inst)
+        with pytest.raises(DenseMemoryTooLarge):
+            solve_scheduling(
+                net, meta, oracle_fallback=False, small_to_oracle=False
+            )
+
 
 class TestFrontDoorDegrade:
     def test_solve_scheduling_degrades_to_oracle(self, monkeypatch):

@@ -205,10 +205,7 @@ def solve_what_if(
     # one batched fetch for ALL variants: each separate device_get
     # pays this environment's ~100 ms-per-sync charge
     fetched = jax.device_get(outs)
-    cost = np.stack([f[0] for f in fetched])
-    conv = np.stack([f[1] for f in fetched])
-    asg = np.stack([f[2] for f in fetched])
-    rounds = np.stack([f[3] for f in fetched])
+    cost, conv, asg, rounds = (np.stack(x) for x in zip(*fetched))
     asg_np = np.asarray(asg, np.int32)[:, :T]
     asg_np = np.where(
         (asg_np >= 0) & (asg_np < inst.n_machines), asg_np, -1

@@ -56,6 +56,7 @@ from poseidon_tpu.ops.dense_auction import (
     _densify,
     _solve,
     check_table_budget,
+    cold_start,
     default_fuse,
 )
 from poseidon_tpu.ops.transport import (
@@ -295,7 +296,6 @@ def _resident_chain(
     dev, domain_ok, pc_s, ra_s = _redensify(
         dt, cost, n_prefs=n_prefs, smax=smax
     )
-    Tp, Mp = dev.c.shape
     if warm_start:
         asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
             dev, warm_asg, warm_lvl, warm_floor, jnp.int32(1),
@@ -303,10 +303,7 @@ def _resident_chain(
             analytic_init=False,
         )
     else:
-        asg0 = jnp.where(dev.task_valid, -1, Mp).astype(I32)
-        lvl0 = jnp.zeros(Tp, I32)
-        floor0 = jnp.zeros(Mp, I32)
-        eps0 = jnp.maximum(dev.cmax // alpha, 1)
+        asg0, lvl0, floor0, eps0 = cold_start(dev, alpha)
         asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
             dev, asg0, lvl0, floor0, eps0, alpha=alpha,
             max_rounds=max_rounds, smax=smax, analytic_init=True,
@@ -418,33 +415,33 @@ class ResidentSolver:
                 why="not-scheduling-shaped",
             )
         T, P = topo.n_tasks, topo.max_prefs
-        from poseidon_tpu.solver import (
-            SMALL_INSTANCE_MACHINES,
-            SMALL_INSTANCE_TASKS,
-        )
+        from poseidon_tpu.solver import is_small_instance
+
+        def degrade(why: str):
+            # price on device (the models want device inputs) and solve
+            # this round on the oracle
+            inputs_dev = jax.device_put(inputs_host)
+            cost = _jitted_model(cost_model)(inputs_dev)
+            return self._oracle_round(
+                arrays, meta, topo, cost, timings, why=why
+            )
 
         if (
             self.small_to_oracle
             and self.oracle_fallback
             and self._warm is None
-            and T <= SMALL_INSTANCE_TASKS
-            and topo.n_machines <= SMALL_INSTANCE_MACHINES
+            and is_small_instance(T, topo.n_machines)
         ):
             # tiny instance: the subprocess oracle beats the TPU launch
-            # floor; price on device (the models want device inputs)
-            # and solve the round there
-            inputs_dev = jax.device_put(inputs_host)
-            cost = _jitted_model(cost_model)(inputs_dev)
-            return self._oracle_round(
-                arrays, meta, topo, cost, timings, why="small-instance"
-            )
+            # floor (solver.SMALL_INSTANCE_* documents the measurement)
+            return degrade("small-instance")
         dt_host = pad_topology(
             topo, t_min=self._t_floor, m_min=self._m_floor
         )
+        Tp = dt_host.arc_unsched.shape[0]
+        Mp = dt_host.slots.shape[0]
         try:
-            check_table_budget(
-                dt_host.arc_unsched.shape[0], dt_host.slots.shape[0]
-            )
+            check_table_budget(Tp, Mp)
         except DenseMemoryTooLarge as e:
             # degrade loudly BEFORE any device allocation: the guard,
             # not an OOM mid-_redensify, decides oversize instances.
@@ -460,13 +457,9 @@ class ResidentSolver:
                 "resident round exceeds the dense HBM budget (%s); "
                 "degrading to oracle", e,
             )
-            inputs_dev = jax.device_put(inputs_host)
-            cost = _jitted_model(cost_model)(inputs_dev)
-            return self._oracle_round(
-                arrays, meta, topo, cost, timings, why="memory-envelope"
-            )
-        self._t_floor = dt_host.arc_unsched.shape[0]
-        self._m_floor = dt_host.slots.shape[0]
+            return degrade("memory-envelope")
+        self._t_floor = Tp
+        self._m_floor = Mp
         # power-of-two smax bound: top_k cost grows mildly with smax but
         # the static argument stays stable as per-round free slots churn
         smax = min(
@@ -487,8 +480,6 @@ class ResidentSolver:
         inputs_dev, dt = jax.device_put((inputs_host, dt_host))
         timings["upload_ms"] = (time.perf_counter() - t0) * 1000
 
-        Tp = dt_host.arc_unsched.shape[0]
-        Mp = dt_host.slots.shape[0]
         warm = self._warm
         if warm is not None and (
             warm.asg.shape[0] != Tp or warm.floor.shape[0] != Mp

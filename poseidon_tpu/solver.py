@@ -60,6 +60,15 @@ SMALL_INSTANCE_TASKS = 256
 SMALL_INSTANCE_MACHINES = 64
 
 
+def is_small_instance(n_tasks: int, n_machines: int) -> bool:
+    """True when the subprocess oracle beats the TPU launch floor
+    (shared by the front door and the resident solver)."""
+    return (
+        0 < n_tasks <= SMALL_INSTANCE_TASKS
+        and n_machines <= SMALL_INSTANCE_MACHINES
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class SolveOutcome:
     """Result of one scheduling solve, whatever backend produced it."""
@@ -109,8 +118,9 @@ def solve_scheduling(
         small_to_oracle
         and oracle_fallback
         and warm is None
-        and 0 < len(meta.task_uids) <= SMALL_INSTANCE_TASKS
-        and len(meta.machine_names) <= SMALL_INSTANCE_MACHINES
+        and is_small_instance(
+            len(meta.task_uids), len(meta.machine_names)
+        )
     ):
         return _solve_on_oracle(
             net, t0, why="small-instance", timeout_s=oracle_timeout_s

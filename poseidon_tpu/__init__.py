@@ -24,6 +24,6 @@ Layers (SURVEY.md section 7):
 
 from poseidon_tpu.solver import SolveOutcome, solve_scheduling
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = ["SolveOutcome", "solve_scheduling", "__version__"]

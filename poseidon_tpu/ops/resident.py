@@ -400,24 +400,7 @@ class ResidentSolver:
         inputs_host = build_cost_inputs_host(
             E, meta, **(cost_input_kwargs or {})
         )
-        try:
-            topo = extract_topology(
-                meta, arrays["src"], arrays["dst"], arrays["cap"]
-            )
-        except NotSchedulingShaped:
-            # not a builder-taxonomy graph: price it anyway (the models
-            # only need the arc metadata) and solve on the oracle, the
-            # same degradation solve_scheduling provides
-            inputs_dev = jax.device_put(inputs_host)
-            cost = _jitted_model(cost_model)(inputs_dev)
-            return self._oracle_round(
-                arrays, meta, None, cost, timings,
-                why="not-scheduling-shaped",
-            )
-        T, P = topo.n_tasks, topo.max_prefs
-        from poseidon_tpu.solver import is_small_instance
-
-        def degrade(why: str):
+        def degrade(why: str, topo):
             # price on device (the models want device inputs) and solve
             # this round on the oracle
             inputs_dev = jax.device_put(inputs_host)
@@ -426,15 +409,29 @@ class ResidentSolver:
                 arrays, meta, topo, cost, timings, why=why
             )
 
+        try:
+            topo = extract_topology(
+                meta, arrays["src"], arrays["dst"], arrays["cap"]
+            )
+        except NotSchedulingShaped:
+            # not a builder-taxonomy graph: price it anyway (the models
+            # only need the arc metadata) and solve on the oracle, the
+            # same degradation solve_scheduling provides
+            return degrade("not-scheduling-shaped", None)
+        T, P = topo.n_tasks, topo.max_prefs
+        from poseidon_tpu.solver import is_small_instance
+
         if (
             self.small_to_oracle
             and self.oracle_fallback
             and self._warm is None
-            and is_small_instance(T, topo.n_machines)
+            # T == 0 keeps the pre-dedup behavior: an empty round is
+            # trivially "small" and must not pay a TPU compile
+            and (T == 0 or is_small_instance(T, topo.n_machines))
         ):
             # tiny instance: the subprocess oracle beats the TPU launch
             # floor (solver.SMALL_INSTANCE_* documents the measurement)
-            return degrade("small-instance")
+            return degrade("small-instance", topo)
         dt_host = pad_topology(
             topo, t_min=self._t_floor, m_min=self._m_floor
         )
@@ -457,7 +454,7 @@ class ResidentSolver:
                 "resident round exceeds the dense HBM budget (%s); "
                 "degrading to oracle", e,
             )
-            return degrade("memory-envelope")
+            return degrade("memory-envelope", topo)
         self._t_floor = Tp
         self._m_floor = Mp
         # power-of-two smax bound: top_k cost grows mildly with smax but

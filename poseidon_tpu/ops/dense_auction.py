@@ -39,11 +39,20 @@ the LP dual has one multiplier per machine — not per slot):
   machine's derived price rose by >= eps, so rounds make strict dual
   progress; prices only rise within a phase, which preserves
   eps-complementary-slackness for every standing assignment.
-- phases shrink eps by ``alpha``; each phase boundary releases the
-  assignments that violate the tighter eps and re-runs. Costs are
-  pre-scaled by (T + 1), so the final eps = 1 phase pins the exact
+- production solves run a SINGLE phase at eps = 1: cold starts from
+  the analytic two-stage market clearing (whose prices are already
+  CS-consistent for the generic market, leaving only sparse pref
+  repair) and warm starts from the previous round's state. Costs are
+  pre-scaled by (T + 1), so the eps = 1 fixpoint pins the exact
   integer optimum (the classic scaling argument: eps-CS with eps < 1/T
-  in unscaled terms admits no improving exchange).
+  in unscaled terms admits no improving exchange). The eps LADDER
+  (phases shrinking eps by ``alpha``, each boundary releasing the
+  assignments the tighter eps exposes) remains in the kernel and runs
+  whenever a caller passes eps0 > 1 — it was the cold path until the
+  analytic init made it a net loss (measured: flagship 35 rounds / 3
+  phases with the ladder vs 15 / 1 without; the 240-trial adversarial
+  sweep moved 7 -> 8 fuse exhaustions, all solved exactly by the
+  oracle fallback).
 - exactness is certified *in the kernel*: the primal cost minus the
   transportation-LP dual value (at the derived prices) must be < scale.
   The gap and a converged flag come back with the result; a blown fuse
@@ -266,32 +275,6 @@ def build_dense_instance(inst: TransportInstance) -> DenseInstance:
 # the kernel
 # ---------------------------------------------------------------------------
 
-def _ask_prices(dev: DenseInstance, asg, lvl, floor):
-    """Per-machine ask price and fullness.
-
-    A full machine asks its weakest holder's level; a machine with free
-    capacity asks its reserve ``floor`` (NOT zero: a transiently-freed
-    machine advertising 0 makes every holder elsewhere an eps-CS
-    violator at the next phase boundary, collapsing the dual and
-    re-running the whole price war — measured as a 55k-round stall).
-    Floors start at the analytic clearing prices and only fall, via the
-    reverse/deflation step; the final fixpoint drives free machines'
-    floors to 0 so the certificate's complementary slackness is exact.
-    """
-    Mp = dev.s.shape[0]
-    on_machine = (asg >= 0) & (asg < Mp)
-    seg = jnp.where(on_machine, asg, Mp)
-    minlvl = jax.ops.segment_min(
-        jnp.where(on_machine, lvl, INF), seg, num_segments=Mp + 1
-    )[:Mp]
-    cnt = jax.ops.segment_sum(
-        on_machine.astype(I32), seg, num_segments=Mp + 1
-    )[:Mp]
-    full = cnt >= dev.s
-    p = jnp.where(full, jnp.minimum(minlvl, INF), floor)
-    return jnp.where(dev.s > 0, p, INF), full
-
-
 def _task_options(dev: DenseInstance, p, with_values: bool = False):
     """Per-task best/second-best machine values at prices p."""
     v = jnp.minimum(dev.c + p[None, :], INF)
@@ -505,7 +488,16 @@ def _solve(
     def ask_from_layout(slvl, bnd, occ, full, floor):
         """Machine ask prices from the sorted layout: the weakest SEATED
         holder sits at the end of the seated prefix of its segment
-        (levels are sorted descending within a segment)."""
+        (levels are sorted descending within a segment).
+
+        A machine with free capacity asks its reserve ``floor`` — NOT
+        zero: a transiently-freed machine advertising 0 makes every
+        holder elsewhere an eps-CS violator at the next boundary,
+        collapsing the dual and re-running the whole price war
+        (measured as a 55k-round stall). Floors start at the analytic
+        clearing prices and only fall, via the reverse/deflation step;
+        the final fixpoint drives free machines' floors to 0 so the
+        certificate's complementary slackness is exact."""
         last = jnp.clip(bnd[:Mp] + occ - 1, 0, Tp - 1)
         minlvl = jnp.where(occ > 0, slvl[last], INF)
         p = jnp.where(full, jnp.minimum(minlvl, INF), floor)
@@ -794,13 +786,18 @@ def _solve(
         (sm0, slvl0, st0, floor0, eps0.astype(I32), jnp.int32(0),
          jnp.int32(0), jnp.bool_(False), jnp.zeros(128, I32)),
     )
-    _bnd, _occ, _full, seated_f, _waiting = layout(sm)
+    bnd_f, occ_f, full_f, seated_f, _waiting = layout(sm)
     asg, lvl = to_task(sm, slvl, st, seated_f)
 
     # exactness certificate: primal - dual at the ask prices, with
-    # lam = 0 on every non-full machine (complementary slackness)
-    lam, full = _ask_prices(dev, asg, lvl, floor)
-    lam = jnp.where(full & (dev.s > 0), lam, 0)
+    # lam = 0 on every non-full machine (complementary slackness).
+    # The asks come straight from the final sorted layout — deriving
+    # them from task space cost a segment_min + segment_sum (the
+    # scatter class) per solve for the identical values. (At a done
+    # exit no overflow holders exist, so layout fullness == task-space
+    # fullness; a blown fuse reports converged=False regardless.)
+    lam = ask_from_layout(slvl, bnd_f, occ_f, full_f, floor)
+    lam = jnp.where(full_f & (dev.s > 0), lam, 0)
     b1v, _, _ = _task_options(dev, jnp.where(dev.s > 0, lam, INF))
     b1 = jnp.minimum(b1v, dev.u)
     on_machine = (asg >= 0) & (asg < Mp)

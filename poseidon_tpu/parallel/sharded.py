@@ -24,8 +24,8 @@ replicated: it is O(M) ints, thousands of times smaller than the
 [T, M] cost table, so the ICI traffic per round is per-machine
 aggregates only.
 
-When width > 1 wins: the compiled program carries ~28 collectives per
-auction round (collective_account: 12 all-reduce + 16 all-gather of
+When width > 1 wins: the compiled program carries ~25 collectives per
+auction round (collective_account: 9 all-reduce + 16 all-gather of
 O(M) int32), ~4 KiB each at M = 1k. On real v5e ICI (~45 GB/s/link,
 ~1 us/hop public figures) that is ~30-60 us/round of latency-dominated
 collective cost, while sharding the task axis saves (N-1)/N of the

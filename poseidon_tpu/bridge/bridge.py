@@ -1,4 +1,4 @@
-"""Scheduler bridge: pod/node state machine driving the TPU solver.
+"""Scheduler bridge: pod/machine state machine driving the TPU solver.
 
 The first-party core of the reference (src/firmament/scheduler_bridge.cc)
 re-expressed around ``solve_scheduling``: nodes/pods observed from the
@@ -34,6 +34,29 @@ Deliberate fixes over the reference's semantics:
   otherwise reads as mass deletion and wipes scheduler state in one
   tick. The reference trusts every snapshot blindly
   (k8s_api_client.cc:100-160).
+
+Round pipeline (PERF.md "Round pipeline"): the round is split into
+``begin_round`` (graph build + cost-input prep + async solve dispatch;
+the placement download starts immediately on a background thread) and
+``finish_round`` (join the fetch, apply placement deltas). Serial
+callers use ``run_scheduler`` — exactly ``finish_round(begin_round())``
+— while pipelined drivers (cli.py, bench.py config 4) do next-round
+host work (poll parse, observe, KnowledgeBase feed, binding POSTs of
+the previous round) between the two calls, so this environment's flat
+~100 ms sync floor elapses under host work instead of serializing
+after it. State mutations keep the serial order — observations commute
+with the previous round's placement deltas (verified by the
+pipelined-vs-serial equivalence test in tests/test_bridge.py), and
+``finish_round`` drops placements whose pod the overlap window's poll
+already moved (retired, or adopted Running elsewhere) rather than
+clobbering observed truth — so pipelining changes round latency, never
+placements or costs.
+
+Graph builds are O(churn), not O(cluster): every pod/machine state
+transition the bridge applies is mirrored into an
+``IncrementalFlowGraphBuilder`` note, and ``begin_round`` patches the
+previous round's builder columns instead of re-walking every task
+object (``incremental_build=False`` restores the legacy full rebuild).
 """
 
 from __future__ import annotations
@@ -46,13 +69,17 @@ import time
 import numpy as np
 
 from poseidon_tpu.cluster import ClusterState, Machine, Task, TaskPhase
-from poseidon_tpu.graph.builder import FlowGraphBuilder
+from poseidon_tpu.graph.builder import (
+    FlowGraphBuilder,
+    IncrementalFlowGraphBuilder,
+)
 from poseidon_tpu.models.knowledge import (
     KnowledgeBase,
     MachineSample,
     TaskSample,
 )
-from poseidon_tpu.ops.resident import ResidentSolver
+from poseidon_tpu.ops.resident import InflightSolve, ResidentSolver
+from poseidon_tpu.ops.transport import topology_from_columns
 from poseidon_tpu.trace import TraceGenerator
 
 log = logging.getLogger(__name__)
@@ -66,7 +93,20 @@ SHRINK_MIN_KNOWN = 8
 @dataclasses.dataclass
 class SchedulerStats:
     """Per-round statistics (the reference collects these and drops
-    them; here they are the observability surface, SURVEY §5.1/§5.5)."""
+    them; here they are the observability surface, SURVEY §5.1/§5.5).
+
+    ``total_ms`` is the round's HOST critical path: time spent inside
+    ``begin_round`` plus time spent inside ``finish_round`` — for a
+    serial round that is the whole round, for a pipelined round it
+    excludes the overlap window where the host was doing other work.
+    The overlap-model companions: ``build_mode`` ("delta" | "full" |
+    "legacy"), ``dispatch_ms`` (prep + upload + async dispatch inside
+    the solver), ``fetch_wait_ms`` (the part of the placement download
+    the host actually blocked on — the sync floor minus whatever the
+    overlap already absorbed), ``overlap_ms`` (wall time between
+    begin and finish, i.e. how much host work was hidden), and
+    ``wall_ms`` (begin start to finish end, the round's wall span).
+    """
 
     round_num: int = 0
     pods_total: int = 0
@@ -81,6 +121,11 @@ class SchedulerStats:
     solve_ms: float = 0.0
     decompose_ms: float = 0.0
     total_ms: float = 0.0
+    build_mode: str = ""
+    dispatch_ms: float = 0.0
+    fetch_wait_ms: float = 0.0
+    overlap_ms: float = 0.0
+    wall_ms: float = 0.0
 
 
 @dataclasses.dataclass
@@ -90,6 +135,20 @@ class RoundResult:
     bindings: dict[str, str]          # pod uid -> machine name (new PLACEs)
     stats: SchedulerStats
     unscheduled: list[str]            # pods left pending this round
+
+
+@dataclasses.dataclass
+class InflightRound:
+    """A begun-but-not-finished scheduling round (solve in flight)."""
+
+    stats: SchedulerStats
+    result: RoundResult | None = None   # set when the round completed
+                                        # synchronously (nothing to do)
+    solve: InflightSolve | None = None
+    meta: object = None                 # GraphMeta of this round's build
+    t_begin_start: float = 0.0
+    t_begin_end: float = 0.0
+    begin_ms: float = 0.0
 
 
 class SchedulerBridge:
@@ -104,6 +163,7 @@ class SchedulerBridge:
         trace: TraceGenerator | None = None,
         solver_timeout_s: float = 1000.0,
         small_to_oracle: bool = True,
+        incremental_build: bool = True,
     ):
         self.cost_model = cost_model
         self.max_tasks_per_machine = max_tasks_per_machine
@@ -119,6 +179,12 @@ class SchedulerBridge:
             oracle_timeout_s=solver_timeout_s,
             small_to_oracle=small_to_oracle,
         )
+        # O(churn) graph maintenance: every state transition below is
+        # mirrored as a note; begin_round patches instead of rebuilding
+        self.incremental_build = incremental_build
+        self._graph = (
+            IncrementalFlowGraphBuilder() if incremental_build else None
+        )
         # bounded: a daemon running forever must not grow without bound
         # (full history goes to the trace stream when a sink is set)
         self.decision_log: collections.deque[tuple[int, str, str]] = (
@@ -128,6 +194,7 @@ class SchedulerBridge:
         # consecutive implausible-shrink polls (mass-eviction guard)
         self._node_shrink_strikes = 0
         self._pod_shrink_strikes = 0
+        self._inflight: InflightRound | None = None
 
     def _hold_shrink(self, counter: str, kind: str, known: int,
                      gone: int) -> bool:
@@ -160,6 +227,7 @@ class SchedulerBridge:
 
     def observe_nodes(self, nodes: list[Machine]) -> None:
         """Upsert machines; release the ones that disappeared."""
+        g = self._graph
         known_before = len(self.machines)
         known_names = set(self.machines)
         seen = set()
@@ -169,8 +237,15 @@ class SchedulerBridge:
                     node, max_tasks=self.max_tasks_per_machine
                 )
             seen.add(node.name)
-            if node.name not in self.machines:
+            prev = self.machines.get(node.name)
+            if prev is None:
                 log.info("new node %s (rack=%s)", node.name, node.rack)
+                if g:
+                    g.note_full_rebuild("node added")
+            elif g and (prev.rack != node.rack
+                        or prev.max_tasks != node.max_tasks):
+                # graph-shaping attributes changed under us
+                g.note_full_rebuild("node reshaped")
             self.machines[node.name] = node
             cap = max(node.cpu_capacity, 1e-9)
             mem_cap = max(node.memory_capacity_kb, 1)
@@ -188,6 +263,8 @@ class SchedulerBridge:
             "_node_shrink_strikes", "node", known_before, len(gone)
         ):
             return
+        if gone and g:
+            g.note_full_rebuild("node removed")
         for name in gone:
             log.warning("node %s removed; evicting its tasks", name)
             del self.machines[name]
@@ -202,9 +279,28 @@ class SchedulerBridge:
                                     round_num=self.round_num)
                     self._evictions_this_round += 1
 
+    def _pending_reobserved(
+        self, known: Task, pod: Task, stored: Task
+    ) -> None:
+        """Graph notes for a pending pod re-observed as pending (the
+        stored object is swapped; only cpu/mem changes are patchable —
+        job/pref reshapes change arc structure mid-order)."""
+        g = self._graph
+        if not g:
+            return
+        if known.job != pod.job or not (
+            known.data_prefs is pod.data_prefs
+            or known.data_prefs == pod.data_prefs
+        ):
+            g.note_full_rebuild("pending pod reshaped")
+        elif (known.cpu_request != pod.cpu_request
+              or known.memory_request_kb != pod.memory_request_kb):
+            g.note_task_updated(stored)
+
     def observe_pods(self, pods: list[Task]) -> None:
         """The reference's per-pod dispatch (scheduler_bridge.cc:132-162),
         with restart reconcile and terminal-state retirement."""
+        g = self._graph
         known_before = len(self.tasks)
         known_uids = set(self.tasks)
         seen = set()
@@ -217,6 +313,8 @@ class SchedulerBridge:
                     self.trace.emit("SUBMIT", task=pod.uid,
                                     round_num=self.round_num)
                     self.tasks[pod.uid] = pod
+                    if g:
+                        g.note_task_added(pod)
                 elif (
                     known.phase == TaskPhase.RUNNING and known.machine
                 ):
@@ -228,9 +326,15 @@ class SchedulerBridge:
                     pass
                 else:
                     # keep our aging counter across polls
-                    self.tasks[pod.uid] = dataclasses.replace(
+                    stored = dataclasses.replace(
                         pod, wait_rounds=known.wait_rounds
                     )
+                    if known.phase != TaskPhase.PENDING:
+                        if g:
+                            g.note_full_rebuild("pod re-entered pending")
+                    else:
+                        self._pending_reobserved(known, pod, stored)
+                    self.tasks[pod.uid] = stored
             elif pod.phase == TaskPhase.RUNNING:
                 if pod.machine and pod.machine not in self.machines:
                     # The apiserver still reports a binding to a node we
@@ -243,10 +347,18 @@ class SchedulerBridge:
                         "Pending for re-placement", pod.uid, pod.machine,
                     )
                     wait = known.wait_rounds if known is not None else 0
-                    self.tasks[pod.uid] = dataclasses.replace(
+                    stored = dataclasses.replace(
                         pod, phase=TaskPhase.PENDING, machine="",
                         wait_rounds=wait,
                     )
+                    if known is None:
+                        if g:
+                            g.note_task_added(stored)
+                    elif known.phase == TaskPhase.PENDING:
+                        self._pending_reobserved(known, pod, stored)
+                    elif g:
+                        g.note_full_rebuild("pod re-entered pending")
+                    self.tasks[pod.uid] = stored
                     self.pod_to_machine.pop(pod.uid, None)
                     continue
                 if known is None or known.machine != pod.machine:
@@ -257,6 +369,19 @@ class SchedulerBridge:
                         "adopting running pod %s on %s",
                         pod.uid, pod.machine,
                     )
+                if g:
+                    if known is not None and known.phase == TaskPhase.PENDING:
+                        g.note_task_removed(pod.uid)
+                    was_on = (
+                        known.machine
+                        if known is not None
+                        and known.phase == TaskPhase.RUNNING else ""
+                    )
+                    if was_on != pod.machine:
+                        if was_on and was_on in self.machines:
+                            g.note_slots_changed(was_on, -1)
+                        if pod.machine:
+                            g.note_slots_changed(pod.machine, +1)
                 self.tasks[pod.uid] = pod
                 if pod.machine:
                     self.pod_to_machine[pod.uid] = pod.machine
@@ -274,6 +399,7 @@ class SchedulerBridge:
                                     machine=known.machine,
                                     round_num=self.round_num,
                                     detail={"phase": str(pod.phase.value)})
+                    self._retire_notes(known)
                     self.tasks.pop(pod.uid, None)
                     self.pod_to_machine.pop(pod.uid, None)
                     self.knowledge.retire_task(pod.uid)
@@ -283,9 +409,22 @@ class SchedulerBridge:
         ):
             return
         for uid in gone:
-            self.tasks.pop(uid, None)
+            task = self.tasks.pop(uid, None)
+            if task is not None:
+                self._retire_notes(task)
             self.pod_to_machine.pop(uid, None)
             self.knowledge.retire_task(uid)
+
+    def _retire_notes(self, task: Task) -> None:
+        """Graph notes for a task leaving the cluster entirely."""
+        g = self._graph
+        if not g:
+            return
+        if task.phase == TaskPhase.PENDING:
+            g.note_task_removed(task.uid)
+        elif (task.phase == TaskPhase.RUNNING
+              and task.machine in self.machines):
+            g.note_slots_changed(task.machine, -1)
 
     # ---- the scheduling round ------------------------------------------
 
@@ -296,8 +435,24 @@ class SchedulerBridge:
         )
 
     def run_scheduler(self) -> RoundResult:
-        """One round: build -> price -> solve -> deltas (the reference's
-        RunScheduler + ScheduleAllJobs, scheduler_bridge.cc:129-192)."""
+        """One serial round: build -> price -> solve -> deltas (the
+        reference's RunScheduler + ScheduleAllJobs,
+        scheduler_bridge.cc:129-192). Exactly ``begin_round`` +
+        ``finish_round`` with no overlapped work between."""
+        return self.finish_round(self.begin_round())
+
+    def begin_round(self) -> InflightRound:
+        """Build the graph and dispatch the solve asynchronously.
+
+        Returns an ``InflightRound``; the caller may do unrelated host
+        work (next poll, binding POSTs) before ``finish_round``. One
+        round in flight at a time.
+        """
+        if self._inflight is not None:
+            raise RuntimeError(
+                "a scheduling round is already in flight; call "
+                "finish_round() first"
+            )
         self.round_num += 1
         stats = SchedulerStats(round_num=self.round_num)
         stats.evictions = self._evictions_this_round
@@ -310,30 +465,45 @@ class SchedulerBridge:
         stats.pods_pending = len(pending)
         if not self.machines or not pending:
             stats.total_ms = (time.perf_counter() - t_start) * 1000
+            stats.wall_ms = stats.total_ms
             self.trace.emit(
                 "ROUND", round_num=self.round_num,
                 detail=dataclasses.asdict(stats),
             )
             self.trace.flush()
-            return RoundResult(bindings={}, stats=stats, unscheduled=[])
+            return InflightRound(
+                stats=stats,
+                result=RoundResult(bindings={}, stats=stats,
+                                   unscheduled=[]),
+            )
 
         t0 = time.perf_counter()
-        arrays, meta = FlowGraphBuilder().build_arrays(cluster)
+        topology = None
+        if self._graph is not None:
+            arrays, meta = self._graph.build_arrays(cluster, pending)
+            stats.build_mode = self._graph.last_build_mode
+            topology = topology_from_columns(self._graph.columns)
+            cpu_col, mem_col = self._graph.cost_columns()
+        else:
+            arrays, meta = FlowGraphBuilder().build_arrays(cluster)
+            stats.build_mode = "legacy"
+            cpu_col = np.array(
+                [int(t.cpu_request * 1000) for t in pending]
+            )
+            mem_col = np.array([t.memory_request_kb for t in pending])
         stats.build_ms = (time.perf_counter() - t0) * 1000
 
-        machine_names = [m.name for m in cluster.machines]
-        outcome = self.solver.run_round(
+        machine_names = meta.machine_names
+        t0 = time.perf_counter()
+        solve = self.solver.begin_round(
             arrays, meta,
             cost_model=self.cost_model,
+            topology=topology,
             cost_input_kwargs=dict(
-                task_cpu_milli=np.array(
-                    [int(t.cpu_request * 1000) for t in pending]
-                ),
-                task_mem_kb=np.array(
-                    [t.memory_request_kb for t in pending]
-                ),
+                task_cpu_milli=cpu_col,
+                task_mem_kb=mem_col,
                 task_usage=self.knowledge.task_cpu_usage(
-                    [t.uid for t in pending]
+                    meta.task_uids
                 ),
                 machine_load=self.knowledge.machine_load(machine_names),
                 machine_mem_free=self.knowledge.machine_mem_free(
@@ -341,6 +511,33 @@ class SchedulerBridge:
                 ),
             ),
         )
+        t_end = time.perf_counter()
+        stats.dispatch_ms = (t_end - t0) * 1000
+        ir = InflightRound(
+            stats=stats,
+            solve=solve,
+            meta=meta,
+            t_begin_start=t_start,
+            t_begin_end=t_end,
+            begin_ms=(t_end - t_start) * 1000,
+        )
+        self._inflight = ir
+        return ir
+
+    def finish_round(self, ir: InflightRound) -> RoundResult:
+        """Join the in-flight solve and apply this round's deltas
+        (bindings, aging, stats, trace)."""
+        if ir.result is not None:
+            return ir.result
+        if self._inflight is not ir:
+            raise RuntimeError("finish_round() got a stale round")
+        self._inflight = None
+        stats = ir.stats
+        t_fin = time.perf_counter()
+        stats.overlap_ms = (t_fin - ir.t_begin_end) * 1000
+
+        outcome = self.solver.finish_round(ir.solve)
+        meta = ir.meta
         # phase accounting: prep+upload feed the price column, the pure
         # device compute is the solve column, the result download the
         # decompose column (transfer vs compute stays distinguishable)
@@ -353,6 +550,7 @@ class SchedulerBridge:
             outcome.timings.get("fetch_ms", 0.0)
             + outcome.timings.get("oracle_ms", 0.0)
         )
+        stats.fetch_wait_ms = outcome.timings.get("fetch_wait_ms", 0.0)
         stats.backend = outcome.backend
         stats.cost = outcome.cost
 
@@ -364,37 +562,71 @@ class SchedulerBridge:
 
         bindings: dict[str, str] = {}
         unscheduled: list[str] = []
+        g = self._graph
         for uid, machine in placements.items():
             task = self.tasks.get(uid)
-            if task is None:
+            if task is None or task.phase != TaskPhase.PENDING:
+                # the overlap window's poll already moved this pod —
+                # retired, or adopted as Running elsewhere (another
+                # scheduler / watch catch-up). The in-flight decision
+                # is stale for it: binding it would clobber observed
+                # truth with a conflicting POST, aging it would age a
+                # pod that is not waiting. Skip; a still-pending pod is
+                # simply re-offered next round.
                 continue
+            if machine is not None and machine not in self.machines:
+                # the target machine disappeared during the overlap
+                # window (node removal): confirming would park the pod
+                # Running on a ghost. Treat the pod as unplaced — it
+                # ages and is reported unscheduled like any other
+                # pending pod this round left behind (the node removal
+                # already forced a full rebuild).
+                machine = None
             if machine is None:
                 # aging: parked pods push harder next round (the
                 # Quincy/CoCo unscheduled-cost input)
                 self.tasks[uid] = dataclasses.replace(
                     task, wait_rounds=task.wait_rounds + 1
                 )
+                if g:
+                    g.note_task_aged(uid)
                 unscheduled.append(uid)
             else:
                 bindings[uid] = machine
                 self.decision_log.append((self.round_num, uid, machine))
                 self.trace.emit("SCHEDULE", task=uid, machine=machine,
-                                round_num=self.round_num)
+                                round_num=ir.stats.round_num)
                 log.info(
                     "round %d: PLACE %s -> %s",
-                    self.round_num, uid, machine,
+                    ir.stats.round_num, uid, machine,
                 )
         stats.pods_placed = len(bindings)
         stats.pods_unscheduled = len(unscheduled)
-        stats.total_ms = (time.perf_counter() - t_start) * 1000
+        t_now = time.perf_counter()
+        stats.total_ms = ir.begin_ms + (t_now - t_fin) * 1000
+        stats.wall_ms = (t_now - ir.t_begin_start) * 1000
         self.trace.emit(
-            "ROUND", round_num=self.round_num,
+            "ROUND", round_num=ir.stats.round_num,
             detail=dataclasses.asdict(stats),
         )
         self.trace.flush()
         return RoundResult(
             bindings=bindings, stats=stats, unscheduled=unscheduled
         )
+
+    def cancel_round(self, ir: InflightRound | None = None) -> None:
+        """Abandon an in-flight round (driver error path): join and
+        discard the solve so the next ``begin_round`` starts clean."""
+        ir = ir if ir is not None else self._inflight
+        if ir is None:
+            return
+        if self._inflight is ir:
+            self._inflight = None
+        if ir.solve is not None:
+            # drain-only: certificate checks / oracle fallback would
+            # block the error-recovery path (up to the full oracle
+            # timeout) for a result being thrown away
+            self.solver.discard_round(ir.solve)
 
     @property
     def solver_timeout_s(self) -> float:
@@ -424,8 +656,35 @@ class SchedulerBridge:
         """Caller reports a successful bindings POST: mark Running so the
         next build discounts the slot even before the poll reflects it."""
         task = self.tasks.get(uid)
-        if task is not None:
-            self.tasks[uid] = dataclasses.replace(
-                task, phase=TaskPhase.RUNNING, machine=machine
-            )
-            self.pod_to_machine[uid] = machine
+        if task is None:
+            return
+        g = self._graph
+        if g:
+            if task.phase == TaskPhase.PENDING:
+                g.note_task_removed(uid)
+                g.note_slots_changed(machine, +1)
+            elif task.phase == TaskPhase.RUNNING and \
+                    task.machine != machine:
+                if task.machine and task.machine in self.machines:
+                    g.note_slots_changed(task.machine, -1)
+                g.note_slots_changed(machine, +1)
+        self.tasks[uid] = dataclasses.replace(
+            task, phase=TaskPhase.RUNNING, machine=machine
+        )
+        self.pod_to_machine[uid] = machine
+
+    def revoke_binding(self, uid: str) -> None:
+        """A bindings POST failed after an optimistic ``confirm_binding``
+        (the pipelined loop confirms before POSTing, cli.py): flip the
+        pod back to Pending so the next round re-offers it. The pod
+        re-enters the pending order mid-sequence, so the next graph
+        build is a full rebuild."""
+        task = self.tasks.get(uid)
+        if task is None:
+            return
+        self.tasks[uid] = dataclasses.replace(
+            task, phase=TaskPhase.PENDING, machine=""
+        )
+        self.pod_to_machine.pop(uid, None)
+        if self._graph:
+            self._graph.note_full_rebuild("binding revoked")

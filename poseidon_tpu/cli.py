@@ -18,12 +18,21 @@ Differences from the reference loop, on purpose:
   that arrived during a failed tick);
 - successful bindings are confirmed into the bridge immediately so the
   next round's capacity math does not depend on poll latency;
-- the tick stays deliberately serial (SURVEY §7 suggests overlapping
-  solve with the next poll to fix the reference's blocking loop): with
-  the TPU solve at ~10-100 ms against a 10 s polling period, pipelining
-  rounds would buy nothing and would let a solve run against stale
-  observations. The solve itself is already asynchronous on device
-  until its results are read.
+- the round is pipelined by default (``--round_pipeline=true``, the
+  SURVEY §7 suggestion the reference never implemented): the solve for
+  round N is dispatched asynchronously and its placement download runs
+  on a background thread, while the loop POSTs round N-1's bindings,
+  sleeps, and parses/observes the next poll — so on links where every
+  host sync costs ~100 ms flat (PERF.md "Round pipeline") the sync
+  floor elapses under host work instead of after it. A solve never
+  runs against stale observations: each round is built AFTER that
+  tick's poll is applied and AFTER the previous round's placements
+  landed; only *unrelated* work overlaps the in-flight solve.
+  ``--round_pipeline=false`` restores the strictly serial tick.
+  Pipelined binding POSTs are confirmed optimistically (the bridge
+  marks the pod Running when the round finishes, the POST follows in
+  the next tick's overlap window); a failed POST revokes the binding
+  so the pod is re-offered.
 
 Run: ``python -m poseidon_tpu.cli --k8s_apiserver_port=8080
 --flow_scheduling_cost_model=quincy --max_rounds=0``
@@ -66,6 +75,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--run_incremental_scheduler",
                    default="true", choices=["true", "false"],
                    help="reuse on-HBM warm state across rounds")
+    p.add_argument("--round_pipeline",
+                   default="true", choices=["true", "false"],
+                   help="overlap the in-flight solve/fetch with next-"
+                        "round host work (poll, observe, binding "
+                        "POSTs); false = strictly serial ticks")
+    p.add_argument("--incremental_build",
+                   default="true", choices=["true", "false"],
+                   help="O(churn) delta graph builds across rounds; "
+                        "false = full rebuild every round")
     p.add_argument("--max_solver_runtime", type=int,
                    default=1_000_000_000,
                    help="microseconds; bounds one oracle-fallback solve "
@@ -145,6 +163,28 @@ def parse_args(argv: list[str]) -> argparse.Namespace:
     return args
 
 
+def _post_bindings(client, bridge, bindings: dict[str, str]):
+    """POST bindings concurrently (bounded): serially, a 10k-placement
+    round is 10k sequential HTTP round trips — the reference has the
+    same flaw (one pplx chain joined per pod, k8s_api_client.cc:225).
+    Returns [(uid, machine, ok)]; the caller decides confirm/revoke
+    (the bridge is not thread-safe, so state changes stay on the main
+    thread)."""
+    import concurrent.futures as _cf
+
+    def _bind(item):
+        uid, machine = item
+        task = bridge.tasks.get(uid)
+        ns = task.namespace if task else "default"
+        return uid, machine, client.bind_pod_to_node(
+            uid, machine, namespace=ns
+        )
+
+    workers = min(16, len(bindings))
+    with _cf.ThreadPoolExecutor(workers) as pool:
+        return list(pool.map(_bind, bindings.items()))
+
+
 def run_loop(args: argparse.Namespace) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -170,11 +210,52 @@ def run_loop(args: argparse.Namespace) -> int:
         sample_queue_size=args.max_sample_queue_size,
         trace=trace,
         solver_timeout_s=args.max_solver_runtime / 1e6,
+        incremental_build=args.incremental_build == "true",
     )
     incremental = args.run_incremental_scheduler == "true"
+    pipelined = args.round_pipeline == "true"
     stats_fh = open(args.stats_json, "a") if args.stats_json else None
 
     rounds = 0
+    # round-pipeline state: at most one solve in flight across ticks,
+    # plus the finished-but-not-yet-POSTed bindings of the last round
+    inflight = None
+    to_post: dict[str, str] = {}
+
+    def _log_round(result):
+        s = result.stats
+        log.info(
+            "round %d: pending=%d placed=%d unsched=%d cost=%d "
+            "backend=%s build=%s solve=%.1fms total=%.1fms "
+            "overlap=%.1fms",
+            s.round_num, s.pods_pending, s.pods_placed,
+            s.pods_unscheduled, s.cost, s.backend,
+            s.build_mode or "-", s.solve_ms, s.total_ms, s.overlap_ms,
+        )
+        if stats_fh:
+            stats_fh.write(json.dumps(vars(s)) + "\n")
+            stats_fh.flush()
+
+    def _post_and_revoke(to_post):
+        """POST optimistically-confirmed bindings; revoke failures so
+        the pods are re-offered next round."""
+        for uid, machine, ok in _post_bindings(client, bridge, to_post):
+            if not ok:
+                log.warning("bind POST failed for %s; revoking", uid)
+                bridge.revoke_binding(uid)
+
+    def _round_done(result, pending_posts):
+        """Log + count one completed round; True = max_rounds reached
+        (any not-yet-POSTed bindings are flushed before exiting)."""
+        nonlocal rounds
+        _log_round(result)
+        rounds += 1
+        if args.max_rounds and rounds >= args.max_rounds:
+            if pending_posts:
+                _post_and_revoke(pending_posts)
+            return True
+        return False
+
     try:
         while True:
             tick_start = time.perf_counter()
@@ -187,54 +268,70 @@ def run_loop(args: argparse.Namespace) -> int:
                 continue
             bridge.observe_nodes(nodes)
             bridge.observe_pods(pods)
-            if not incremental:
+            if not incremental and not pipelined:
                 bridge.warm_state = None
             try:
-                result = bridge.run_scheduler()
+                if pipelined:
+                    # finish the solve dispatched last tick (its fetch
+                    # ran while we slept/polled/observed), then start
+                    # this tick's round and POST the finished round's
+                    # bindings while the new solve is in flight
+                    if inflight is not None:
+                        result = bridge.finish_round(inflight)
+                        inflight = None
+                        # optimistic confirm: the next build discounts
+                        # the slots now; the POST follows below and a
+                        # failure revokes (re-offered next round)
+                        for uid, machine in result.bindings.items():
+                            bridge.confirm_binding(uid, machine)
+                        to_post = dict(result.bindings)
+                        if _round_done(result, to_post):
+                            return 0
+                    if not incremental:
+                        # must happen AFTER finish_round (which commits
+                        # the fresh warm handle) and before the next
+                        # dispatch, or the flag silently does nothing
+                        bridge.warm_state = None
+                    ir = bridge.begin_round()
+                    if ir.result is not None:
+                        # empty round (nothing pending): completed
+                        # synchronously, nothing in flight
+                        if _round_done(ir.result, to_post):
+                            return 0
+                    else:
+                        inflight = ir
+                    if to_post:
+                        _post_and_revoke(to_post)
+                        to_post = {}
+                else:
+                    result = bridge.run_scheduler()
+                    if result.bindings:
+                        for uid, machine, ok in _post_bindings(
+                            client, bridge, result.bindings
+                        ):
+                            if ok:
+                                bridge.confirm_binding(uid, machine)
+                    if _round_done(result, None):
+                        return 0
             except Exception:
                 # a failed round (oracle timeout, device fault) must not
                 # kill the daemon; state is rebuilt from the next poll
                 log.exception("scheduling round failed; skipping tick")
+                if inflight is not None:
+                    bridge.cancel_round(inflight)
+                    inflight = None
+                if to_post:
+                    # bindings confirmed before the failure must still
+                    # reach the apiserver — a persistently failing
+                    # begin_round must not strand them Running-locally
+                    # / Pending-remotely forever
+                    try:
+                        _post_and_revoke(to_post)
+                    except Exception:
+                        log.exception("deferred binding POSTs failed")
+                    to_post = {}
                 time.sleep(args.polling_frequency / 1e6)
                 continue
-            # bindings POST concurrently (bounded): serially, a
-            # 10k-placement round is 10k sequential HTTP round trips —
-            # the reference has the same flaw (one pplx chain joined
-            # per pod, k8s_api_client.cc:225). Confirmations apply on
-            # the main thread; the bridge is not thread-safe.
-            if result.bindings:
-                import concurrent.futures as _cf
-
-                def _bind(item):
-                    uid, machine = item
-                    task = bridge.tasks.get(uid)
-                    ns = task.namespace if task else "default"
-                    return uid, machine, client.bind_pod_to_node(
-                        uid, machine, namespace=ns
-                    )
-
-                workers = min(16, len(result.bindings))
-                with _cf.ThreadPoolExecutor(workers) as pool:
-                    outcomes = list(
-                        pool.map(_bind, result.bindings.items())
-                    )
-                for uid, machine, ok in outcomes:
-                    if ok:
-                        bridge.confirm_binding(uid, machine)
-            s = result.stats
-            log.info(
-                "round %d: pending=%d placed=%d unsched=%d cost=%d "
-                "backend=%s solve=%.1fms total=%.1fms",
-                s.round_num, s.pods_pending, s.pods_placed,
-                s.pods_unscheduled, s.cost, s.backend, s.solve_ms,
-                s.total_ms,
-            )
-            if stats_fh:
-                stats_fh.write(json.dumps(vars(s)) + "\n")
-                stats_fh.flush()
-            rounds += 1
-            if args.max_rounds and rounds >= args.max_rounds:
-                return 0
             elapsed = time.perf_counter() - tick_start
             time.sleep(
                 max(args.polling_frequency / 1e6 - elapsed, 0.0)
@@ -244,6 +341,7 @@ def run_loop(args: argparse.Namespace) -> int:
             stats_fh.close()
         if trace_fh:
             trace_fh.close()
+
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,0 +1,24 @@
+"""JAX version-compatibility shims.
+
+``jax.enable_x64`` / ``jax.shard_map`` are the public spellings on
+newer JAX releases; on the 0.4.x line they only exist under
+``jax.experimental``. Every call site in this package (and the
+bench/tests) imports the symbols from here so the package runs on
+both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    enable_x64 = jax.enable_x64
+except AttributeError:  # jax 0.4.x
+    from jax.experimental import enable_x64  # noqa: F401
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["enable_x64", "shard_map"]

@@ -12,17 +12,38 @@ vector on device, so cost recompute per round is a pure vectorized op.
 
 Node order (deterministic): [sink, cluster_agg, racks..., machines...,
 unsched_aggs..., tasks...].
+
+The build is split in two stages so the per-round cost can scale with
+*churn* instead of cluster size:
+
+- ``FlowGraphBuilder.extract_columns`` walks the Python task/machine
+  objects once and compacts them into ``BuilderColumns`` (numpy columns
+  in canonical pending order) — the only O(tasks·prefs) Python work;
+- ``FlowGraphBuilder.assemble`` turns columns into the arc families +
+  ``GraphMeta`` with pure vectorized numpy.
+
+``IncrementalFlowGraphBuilder`` keeps a live ``BuilderColumns`` and
+patches it from O(K) churn events (task add/remove/update/age, slot
+deltas) fed by the scheduler bridge, falling back to a full re-extract
+on anything it cannot patch (machine-set changes, mid-order pending
+re-inserts). Because both paths share ``assemble``, a delta build is
+bit-identical to a from-scratch build by construction; the differential
+suite in tests/test_incremental.py asserts it anyway.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import logging
 from enum import IntEnum
 
 import numpy as np
 
-from poseidon_tpu.cluster import ClusterState, TaskPhase
+from poseidon_tpu.cluster import ClusterState, Task, TaskPhase
 from poseidon_tpu.graph.network import FlowNetwork
+
+log = logging.getLogger(__name__)
 
 
 class NodeRole(IntEnum):
@@ -73,6 +94,37 @@ class GraphMeta:
     n_arcs: int
 
 
+@dataclasses.dataclass
+class BuilderColumns:
+    """Numpy-columnar snapshot of one round's scheduling input.
+
+    Everything ``assemble`` needs, in canonical order (machines in
+    cluster order; pending tasks in ``ClusterState.pending()`` order;
+    jobs by first occurrence among pending tasks; a task's preference
+    rows task-major in ``data_prefs`` iteration order). ``cpu_milli`` /
+    ``mem_kb`` ride along for the bridge's pricing inputs so a delta
+    round does not re-walk the task objects for them either.
+    """
+
+    machine_names: list[str]
+    midx: dict[str, int]      # machine name -> index
+    m_rack: np.ndarray        # int32[M] rack index or -1
+    m_max: np.ndarray         # int64[M] max_tasks per machine
+    used_slots: np.ndarray    # int64[M] RUNNING tasks bound per machine
+    racks: list[str]
+    uids: np.ndarray          # object[T] pending task uids
+    jobs: np.ndarray          # object[J] job ids, first-occurrence order
+    job_idx: np.ndarray       # int32[T]
+    job_counts: np.ndarray    # int64[J] pending tasks per job
+    wait: np.ndarray          # int32[T]
+    pref_counts: np.ndarray   # int64[T] preference rows per task
+    pref_m: np.ndarray        # int32[Ep] machine index or -1
+    pref_r: np.ndarray        # int32[Ep] rack index or -1
+    pref_w: np.ndarray        # int32[Ep] locality weight
+    cpu_milli: np.ndarray     # int64[T] requested milli-cores
+    mem_kb: np.ndarray        # int64[T] requested memory
+
+
 class FlowGraphBuilder:
     """Builds the MCMF instance for one scheduling round.
 
@@ -105,6 +157,25 @@ class FlowGraphBuilder:
         per-round device traffic is one batched upload of pricing inputs
         — the builder must not force its own src/dst/cap transfer.
         """
+        return self.assemble(self.extract_columns(cluster))
+
+    # ---- stage 1: Python-object walk -> numpy columns -----------------
+
+    def _task_prefs(
+        self, task: Task, midx: dict[str, int], rack_idx: dict[str, int]
+    ) -> list[tuple[int, int, int]]:
+        """One task's resolved (machine_idx, rack_idx, weight) pref rows,
+        in ``data_prefs`` iteration order (unknown names dropped)."""
+        if not self.pref_arcs:
+            return []
+        return [
+            (midx.get(name, -1), rack_idx.get(name, -1), int(weight))
+            for name, weight in task.data_prefs.items()
+            if name in midx or name in rack_idx
+        ]
+
+    def extract_columns(self, cluster: ClusterState) -> BuilderColumns:
+        """The O(tasks·prefs) Python walk, done once per full rebuild."""
         machines = cluster.machines
         tasks = cluster.pending()
         racks = cluster.racks() if self.rack_aggs else []
@@ -112,13 +183,75 @@ class FlowGraphBuilder:
         midx = cluster.machine_index()
 
         jobs: list[str] = []
-        job_idx: dict[str, int] = {}
+        job_lookup: dict[str, int] = {}
         for t in tasks:
-            if t.job_id not in job_idx:
-                job_idx[t.job_id] = len(jobs)
+            if t.job_id not in job_lookup:
+                job_lookup[t.job_id] = len(jobs)
                 jobs.append(t.job_id)
+        J = len(jobs)
+        T = len(tasks)
+        job_idx = np.array(
+            [job_lookup[t.job_id] for t in tasks], dtype=np.int32
+        )
+        job_counts = (
+            np.bincount(job_idx, minlength=J).astype(np.int64)
+            if T else np.zeros(J, np.int64)
+        )
 
-        M, T, R, J = len(machines), len(tasks), len(racks), len(jobs)
+        # Slots already consumed by RUNNING tasks: the reference tracks
+        # running tasks against --max_tasks_per_pu inside Firmament; we
+        # discount machine capacity here so re-offered slots are real.
+        used_slots = np.zeros(len(machines), dtype=np.int64)
+        running = [
+            midx[t.machine] for t in cluster.tasks
+            if t.phase == TaskPhase.RUNNING and t.machine in midx
+        ]
+        if running:
+            np.add.at(used_slots, running, 1)
+
+        per_task = [self._task_prefs(t, midx, rack_idx) for t in tasks]
+        trip = [row for rows in per_task for row in rows]
+        pref_counts = np.array(
+            [len(rows) for rows in per_task], dtype=np.int64
+        ) if T else np.zeros(0, np.int64)
+
+        return BuilderColumns(
+            machine_names=[m.name for m in machines],
+            midx=midx,
+            m_rack=np.array(
+                [rack_idx.get(m.rack, -1) if m.rack else -1
+                 for m in machines],
+                dtype=np.int32,
+            ),
+            m_max=np.array(
+                [int(m.max_tasks) for m in machines], np.int64
+            ),
+            used_slots=used_slots,
+            racks=racks,
+            uids=np.array([t.uid for t in tasks], dtype=object),
+            jobs=np.array(jobs, dtype=object),
+            job_idx=job_idx,
+            job_counts=job_counts,
+            wait=np.array([t.wait_rounds for t in tasks], dtype=np.int32),
+            pref_counts=pref_counts,
+            pref_m=np.array([x[0] for x in trip], dtype=np.int32),
+            pref_r=np.array([x[1] for x in trip], dtype=np.int32),
+            pref_w=np.array([x[2] for x in trip], dtype=np.int32),
+            cpu_milli=np.array(
+                [int(t.cpu_request * 1000) for t in tasks], np.int64
+            ),
+            mem_kb=np.array(
+                [t.memory_request_kb for t in tasks], np.int64
+            ),
+        )
+
+    # ---- stage 2: columns -> arc families + meta (pure numpy) ---------
+
+    def assemble(
+        self, cols: BuilderColumns
+    ) -> tuple[dict[str, np.ndarray], GraphMeta]:
+        M, T = len(cols.machine_names), len(cols.uids)
+        R, J = len(cols.racks), len(cols.jobs)
         # node layout
         SINK = 0
         CLUSTER = 1
@@ -147,54 +280,22 @@ class FlowGraphBuilder:
         # [task->unsched, task->cluster, prefs..., cluster->machine,
         #  rack->machine, machine->sink, unsched->sink]; nothing
         # downstream depends on arc order, only on kind labels.
-        job_of = np.array(
-            [job_idx[t.job_id] for t in tasks], dtype=np.int32
-        )
-        job_task_count = np.bincount(
-            job_of, minlength=J
-        ).astype(np.int64) if T else np.zeros(J, np.int64)
-
-        # Slots already consumed by RUNNING tasks: the reference tracks
-        # running tasks against --max_tasks_per_pu inside Firmament; we
-        # discount machine capacity here so re-offered slots are real.
-        used_slots = np.zeros(M, dtype=np.int64)
-        running = [
-            midx[t.machine] for t in cluster.tasks
-            if t.phase == TaskPhase.RUNNING and t.machine in midx
-        ]
-        if running:
-            np.add.at(used_slots, running, 1)
+        job_of = cols.job_idx
+        job_task_count = cols.job_counts
 
         t_ids = np.arange(T, dtype=np.int32)
         t_nodes = task_base + t_ids
 
-        # ragged preference triples, one pass over the (small) dicts
-        if self.pref_arcs:
-            trip = [
-                (ti, midx.get(name, -1), rack_idx.get(name, -1),
-                 int(weight))
-                for ti, t in enumerate(tasks)
-                for name, weight in t.data_prefs.items()
-                if name in midx or name in rack_idx
-            ]
-        else:
-            trip = []
-        p_t = np.array([x[0] for x in trip], dtype=np.int32)
-        p_m = np.array([x[1] for x in trip], dtype=np.int32)
-        p_r = np.array([x[2] for x in trip], dtype=np.int32)
-        p_w = np.array([x[3] for x in trip], dtype=np.int32)
+        p_t = np.repeat(t_ids, cols.pref_counts)
+        p_m, p_r, p_w = cols.pref_m, cols.pref_r, cols.pref_w
         is_mp = p_m >= 0
 
         m_ids = np.arange(M, dtype=np.int32)
         m_nodes = machine_base + m_ids
-        slots = np.maximum(
-            np.array([int(m.max_tasks) for m in machines], np.int64)
-            - used_slots, 0,
-        ).astype(np.int32)
-        m_rack = np.array(
-            [rack_idx.get(m.rack, -1) if m.rack else -1 for m in machines],
-            dtype=np.int32,
+        slots = np.maximum(cols.m_max - cols.used_slots, 0).astype(
+            np.int32
         )
+        m_rack = cols.m_rack
         has_rack = m_rack >= 0
 
         def fam(n, s, d, c, k, ti=None, mi=None, ri=None, wt=None):
@@ -235,7 +336,7 @@ class FlowGraphBuilder:
                 ArcKind.UNSCHED_TO_SINK),
         ]
         src, dst, cap, kind, a_task, a_machine, a_rack, a_weight = (
-            np.concatenate(cols) for cols in zip(*families)
+            np.concatenate(cols_) for cols_ in zip(*families)
         )
 
         supply = np.zeros(n_nodes, dtype=np.int64)
@@ -251,17 +352,335 @@ class FlowGraphBuilder:
             arc_machine=a_machine,
             arc_rack=a_rack,
             arc_weight=a_weight,
-            task_wait=np.array([t.wait_rounds for t in tasks],
-                               dtype=np.int32),
+            task_wait=cols.wait,
             task_node=np.arange(task_base, task_base + T, dtype=np.int32),
             machine_node=np.arange(machine_base, machine_base + M,
                                    dtype=np.int32),
             node_machine=node_machine,
-            task_uids=[t.uid for t in tasks],
-            machine_names=[m.name for m in machines],
-            rack_names=racks,
-            job_ids=jobs,
+            task_uids=cols.uids.tolist(),
+            machine_names=list(cols.machine_names),
+            rack_names=list(cols.racks),
+            job_ids=cols.jobs.tolist(),
             n_nodes=n_nodes,
             n_arcs=n_arcs,
         )
         return arrays, meta
+
+
+class _DeltaUnsupported(Exception):
+    """A buffered churn event the delta path cannot patch exactly."""
+
+
+class IncrementalFlowGraphBuilder:
+    """O(churn) graph maintenance across scheduling rounds.
+
+    The owner (SchedulerBridge) feeds ``note_*`` events as cluster state
+    mutates; ``build_arrays`` patches the cached ``BuilderColumns`` and
+    re-assembles — O(K) Python work for K churned pods plus vectorized
+    numpy over the arrays, instead of the full O(tasks·prefs) object
+    walk. Any event outside the patchable set (machine add/remove/
+    attribute change, a pod re-entering the pending order mid-sequence,
+    pref/job content changes) flips ``note_full_rebuild`` and the next
+    build re-extracts from the cluster.
+
+    Copy-on-write discipline: columns are replaced, never mutated in
+    place, so arrays referenced by a previous round's ``GraphMeta`` (or
+    already shipped to an in-flight solve) stay frozen.
+
+    Self-healing: every delta build verifies the cached pending-uid
+    sequence and machine-name list against the live cluster; a mismatch
+    (a missed event path) logs a warning and falls back to a full
+    rebuild, so a bookkeeping bug degrades to the old cost, never to a
+    wrong graph.
+    """
+
+    def __init__(self, *, pref_arcs: bool = True, rack_aggs: bool = True):
+        self.builder = FlowGraphBuilder(
+            pref_arcs=pref_arcs, rack_aggs=rack_aggs
+        )
+        self._cols: BuilderColumns | None = None
+        self._uid_pos: dict[str, int] = {}
+        self._added: dict[str, Task] = {}
+        self._removed: set[str] = set()
+        self._updated: dict[str, Task] = {}
+        self._aged: collections.Counter[str] = collections.Counter()
+        self._slot_delta: collections.Counter[str] = collections.Counter()
+        self._rebuild: str | None = "cold"
+        self.last_build_mode = ""
+        self.builds_full = 0
+        self.builds_delta = 0
+
+    # ---- churn notifications (all O(1)) -------------------------------
+
+    def note_full_rebuild(self, why: str) -> None:
+        if self._rebuild is None:
+            self._rebuild = why
+            self._added.clear()
+            self._removed.clear()
+            self._updated.clear()
+            self._aged.clear()
+            self._slot_delta.clear()
+
+    def note_task_added(self, task: Task) -> None:
+        """A NEW pending pod appended at the end of the pending order."""
+        if self._rebuild is not None:
+            return
+        if task.uid in self._removed or task.uid in self._uid_pos \
+                or task.uid in self._added:
+            # re-adds / duplicates cannot preserve the canonical order
+            self.note_full_rebuild("pending re-insert")
+            return
+        self._added[task.uid] = task
+
+    def note_task_removed(self, uid: str) -> None:
+        """A pod left the pending set (placed, retired, disappeared)."""
+        if self._rebuild is not None:
+            return
+        if uid in self._added:
+            del self._added[uid]
+            self._aged.pop(uid, None)
+            self._updated.pop(uid, None)
+            return
+        if uid in self._uid_pos:
+            self._removed.add(uid)
+            self._updated.pop(uid, None)
+            return
+        self.note_full_rebuild("unknown pending removal")
+
+    def note_task_updated(self, task: Task) -> None:
+        """An existing pending pod's cpu/mem request changed in place
+        (same uid, same position, same job + prefs)."""
+        if self._rebuild is not None:
+            return
+        if task.uid in self._added:
+            self._added[task.uid] = task
+        elif task.uid in self._uid_pos:
+            self._updated[task.uid] = task
+        else:
+            self.note_full_rebuild("unknown pending update")
+
+    def note_task_aged(self, uid: str, rounds: int = 1) -> None:
+        """A pending pod's wait_rounds grew by ``rounds``."""
+        if self._rebuild is not None:
+            return
+        self._aged[uid] += rounds
+
+    def note_slots_changed(self, machine: str, delta: int) -> None:
+        """A machine's RUNNING-task count changed by ``delta``."""
+        if self._rebuild is not None:
+            return
+        self._slot_delta[machine] += delta
+
+    # ---- build --------------------------------------------------------
+
+    @property
+    def columns(self) -> BuilderColumns | None:
+        return self._cols
+
+    def cost_columns(self) -> tuple[np.ndarray, np.ndarray]:
+        """(task_cpu_milli, task_mem_kb) for the current pending order."""
+        assert self._cols is not None
+        return self._cols.cpu_milli, self._cols.mem_kb
+
+    def build_arrays(
+        self,
+        cluster: ClusterState,
+        pending: list[Task] | None = None,
+    ) -> tuple[dict[str, np.ndarray], GraphMeta]:
+        if pending is None:
+            pending = cluster.pending()
+        if self._rebuild is None and self._cols is not None:
+            try:
+                self._apply_deltas()
+            except _DeltaUnsupported as e:
+                self.note_full_rebuild(str(e))
+        if self._rebuild is None and self._cols is not None:
+            cols = self._cols
+            # self-healing guard: the pending-uid sequence is the one
+            # invariant every patch depends on — verify it in full each
+            # build (O(T) C-level compare). Machines change only
+            # through observe_nodes, which always notes; the length
+            # check catches a bypassing mutation without paying an
+            # O(M) name walk per round at 12k machines.
+            ok = (
+                len(pending) == len(cols.uids)
+                and len(cluster.machines) == len(cols.machine_names)
+                and [t.uid for t in pending] == cols.uids.tolist()
+            )
+            if not ok:
+                log.warning(
+                    "incremental graph state diverged from the cluster "
+                    "(missed churn event?); falling back to full rebuild"
+                )
+                self.note_full_rebuild("verify-mismatch")
+        if self._rebuild is not None:
+            self._cols = self.builder.extract_columns(cluster)
+            self._uid_pos = {
+                u: i for i, u in enumerate(self._cols.uids.tolist())
+            }
+            self._rebuild = None
+            self._added.clear()
+            self._removed.clear()
+            self._updated.clear()
+            self._aged.clear()
+            self._slot_delta.clear()
+            self.last_build_mode = "full"
+            self.builds_full += 1
+        else:
+            self.last_build_mode = "delta"
+            self.builds_delta += 1
+        return self.builder.assemble(self._cols)
+
+    # ---- the O(K) patch ----------------------------------------------
+
+    def _apply_deltas(self) -> None:
+        cols = self._cols
+        assert cols is not None
+        if not (self._added or self._removed or self._updated
+                or self._aged or self._slot_delta):
+            return
+        uids = cols.uids
+        jobs = cols.jobs
+        job_idx = cols.job_idx
+        job_counts = cols.job_counts
+        wait = cols.wait
+        pref_counts = cols.pref_counts
+        pref_m, pref_r, pref_w = cols.pref_m, cols.pref_r, cols.pref_w
+        cpu, mem = cols.cpu_milli, cols.mem_kb
+        used_slots = cols.used_slots
+        T, J = len(uids), len(jobs)
+
+        if self._updated:
+            cpu = cpu.copy()
+            mem = mem.copy()
+            for uid, t in self._updated.items():
+                p = self._uid_pos[uid]
+                cpu[p] = int(t.cpu_request * 1000)
+                mem[p] = t.memory_request_kb
+
+        if self._aged:
+            wait = wait.copy()
+            for uid, n in self._aged.items():
+                p = self._uid_pos.get(uid)
+                if p is None:
+                    raise _DeltaUnsupported("aging of unknown task")
+                wait[p] += n
+
+        if self._removed:
+            pos = np.fromiter(
+                (self._uid_pos[u] for u in self._removed),
+                np.int64, len(self._removed),
+            )
+            keep = np.ones(T, bool)
+            keep[pos] = False
+            pref_keep = np.repeat(keep, pref_counts)
+            job_counts = job_counts - np.bincount(
+                job_idx[pos], minlength=J
+            )
+            uids = uids[keep]
+            job_idx = job_idx[keep]
+            wait = wait[keep]
+            cpu = cpu[keep]
+            mem = mem[keep]
+            pref_counts = pref_counts[keep]
+            pref_m = pref_m[pref_keep]
+            pref_r = pref_r[pref_keep]
+            pref_w = pref_w[pref_keep]
+            if (job_counts == 0).any():
+                jkeep = job_counts > 0
+                remap = (np.cumsum(jkeep) - 1).astype(np.int32)
+                job_idx = remap[job_idx]
+                jobs = jobs[jkeep]
+                job_counts = job_counts[jkeep]
+            # canonical job order is first occurrence among pending
+            # tasks; removals can promote a later block's job past an
+            # earlier one — re-permute to match what a fresh extract
+            # would produce
+            if len(job_idx):
+                _, first = np.unique(job_idx, return_index=True)
+                perm = np.argsort(first, kind="stable")
+                if not np.array_equal(perm, np.arange(len(perm))):
+                    inv = np.empty(len(perm), np.int32)
+                    inv[perm] = np.arange(len(perm), dtype=np.int32)
+                    job_idx = inv[job_idx]
+                    jobs = jobs[perm]
+                    job_counts = job_counts[perm]
+    
+        if self._added:
+            midx = cols.midx
+            rack_idx = {r: i for i, r in enumerate(cols.racks)}
+            job_lookup = {j: i for i, j in enumerate(jobs.tolist())}
+            new_jobs: list[str] = []
+            a_job, a_wait, a_cpu, a_mem, a_cnt = [], [], [], [], []
+            a_pm, a_pr, a_pw = [], [], []
+            for t in self._added.values():
+                jid = t.job_id
+                ji = job_lookup.get(jid)
+                if ji is None:
+                    ji = len(job_lookup)
+                    job_lookup[jid] = ji
+                    new_jobs.append(jid)
+                a_job.append(ji)
+                a_wait.append(t.wait_rounds)
+                a_cpu.append(int(t.cpu_request * 1000))
+                a_mem.append(t.memory_request_kb)
+                rows = self.builder._task_prefs(t, midx, rack_idx)
+                a_cnt.append(len(rows))
+                for m, r, w in rows:
+                    a_pm.append(m)
+                    a_pr.append(r)
+                    a_pw.append(w)
+            a_job_arr = np.array(a_job, np.int32)
+            uids = np.concatenate([
+                uids, np.array(list(self._added), dtype=object),
+            ])
+            job_idx = np.concatenate([job_idx, a_job_arr])
+            wait = np.concatenate([wait, np.array(a_wait, np.int32)])
+            cpu = np.concatenate([cpu, np.array(a_cpu, np.int64)])
+            mem = np.concatenate([mem, np.array(a_mem, np.int64)])
+            pref_counts = np.concatenate(
+                [pref_counts, np.array(a_cnt, np.int64)]
+            )
+            pref_m = np.concatenate([pref_m, np.array(a_pm, np.int32)])
+            pref_r = np.concatenate([pref_r, np.array(a_pr, np.int32)])
+            pref_w = np.concatenate([pref_w, np.array(a_pw, np.int32)])
+            if new_jobs:
+                jobs = np.concatenate(
+                    [jobs, np.array(new_jobs, dtype=object)]
+                )
+            job_counts = np.bincount(
+                a_job_arr, minlength=len(jobs)
+            ).astype(np.int64) + np.concatenate([
+                job_counts,
+                np.zeros(len(jobs) - len(job_counts), np.int64),
+            ])
+
+        if self._slot_delta:
+            used_slots = used_slots.copy()
+            for name, d in self._slot_delta.items():
+                i = cols.midx.get(name)
+                if i is None:
+                    raise _DeltaUnsupported("slot delta on unknown machine")
+                used_slots[i] += d
+            if (used_slots < 0).any():
+                raise _DeltaUnsupported("negative running-slot count")
+
+        self._cols = dataclasses.replace(
+            cols, uids=uids, jobs=jobs, job_idx=job_idx,
+            job_counts=job_counts, wait=wait, pref_counts=pref_counts,
+            pref_m=pref_m, pref_r=pref_r, pref_w=pref_w,
+            cpu_milli=cpu, mem_kb=mem, used_slots=used_slots,
+        )
+        if self._removed:
+            self._uid_pos = {
+                u: i for i, u in enumerate(uids.tolist())
+            }
+        elif self._added:
+            base = len(self._uid_pos)
+            for k, uid in enumerate(self._added):
+                self._uid_pos[uid] = base + k
+        self._added.clear()
+        self._removed.clear()
+        self._updated.clear()
+        self._aged.clear()
+        self._slot_delta.clear()

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from poseidon_tpu.compat import enable_x64
 from poseidon_tpu.ops.dense_auction import (
     I32,
     INF,
@@ -183,11 +184,20 @@ def solve_what_if(
     are NOT vmapped)."""
     dev = build_dense_instance(inst)
     # the batch holds n_variants full cost tables at once — the memory
-    # guard must scale with the batch, not just the single instance
+    # guard must scale with the batch, not just the single instance —
+    # PLUS the perturbed u/w (Tp each) and dgen (Mp) side tables every
+    # variant carries and the perturb kernel's two one-off [Tp, Mp]
+    # generic/pref-part intermediates (ADVICE round 5: these were
+    # previously outside the estimate)
     from poseidon_tpu.ops.dense_auction import check_table_budget
 
-    check_table_budget(dev.c.shape[0], dev.c.shape[1], n_variants)
-    with jax.enable_x64(True):
+    Tp, Mp = dev.c.shape
+    check_table_budget(
+        Tp, Mp, n_variants,
+        side_ints_per_variant=2 * Tp + Mp,
+        extra_ints=2 * Tp * Mp,
+    )
+    with enable_x64(True):
         # perturb_costs does its jitter math in int64; outside this
         # context the casts silently truncate to int32 (round-3 advisor)
         c, u, w, dg, cmax = perturb_costs(

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from poseidon_tpu.compat import enable_x64
 from poseidon_tpu.graph.network import FlowNetwork
 
 I64 = jnp.int64
@@ -330,7 +331,7 @@ def solve_cost_scaling(
     # Prices live in the n-scaled cost domain whose worst case exceeds
     # int32; x64 is scoped to this solve rather than flipped globally at
     # package import (which would silently change caller dtypes).
-    with jax.enable_x64(True):
+    with enable_x64(True):
         return _solve(net, max_sweeps, alpha, sweeps_per_update)
 
 

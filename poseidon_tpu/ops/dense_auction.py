@@ -76,6 +76,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from poseidon_tpu.compat import enable_x64
 from poseidon_tpu.graph.network import pad_bucket
 from poseidon_tpu.ops.transport import (
     CH_CLUSTER,
@@ -125,15 +126,28 @@ DENSE_TABLE_BUDGET_BYTES = (
 )
 
 
-def check_table_budget(Tp: int, Mp: int, n_variants: int = 1) -> None:
+def check_table_budget(
+    Tp: int, Mp: int, n_variants: int = 1,
+    side_ints_per_variant: int = 0, extra_ints: int = 0,
+) -> None:
     """Raise DenseMemoryTooLarge if n_variants dense [Tp, Mp] i32
-    tables exceed the configured HBM budget."""
-    need = Tp * Mp * 4 * n_variants
+    tables exceed the configured HBM budget.
+
+    ``side_ints_per_variant`` counts per-variant i32 arrays beyond the
+    main table (the what-if batch carries perturbed u[Tp] / w[Tp] /
+    dgen[Mp] side tables alongside each c[Tp, Mp]); ``extra_ints``
+    counts one-off i32 scratch (the perturb kernel's generic/pref-part
+    [Tp, Mp] intermediates). Both default to 0 so the single-instance
+    estimate is exactly the main table.
+    """
+    need = (Tp * Mp + side_ints_per_variant) * 4 * n_variants \
+        + extra_ints * 4
     if need > DENSE_TABLE_BUDGET_BYTES:
         raise DenseMemoryTooLarge(
-            f"dense cost table {n_variants} x [{Tp}, {Mp}] i32 = "
-            f"{need >> 20} MiB exceeds the "
-            f"{DENSE_TABLE_BUDGET_BYTES >> 20} MiB budget "
+            f"dense cost table {n_variants} x [{Tp}, {Mp}] i32 "
+            f"(+ {side_ints_per_variant} side ints/variant, "
+            f"{extra_ints} scratch ints) = {need >> 20} MiB exceeds "
+            f"the {DENSE_TABLE_BUDGET_BYTES >> 20} MiB budget "
             f"(POSEIDON_TPU_DENSE_TABLE_BUDGET_MB)"
         )
 
@@ -894,7 +908,7 @@ def solve_dense(
         warm = None  # cluster outgrew its padding bucket: cold solve
     if max_rounds is None:
         max_rounds = default_fuse()
-    with jax.enable_x64(True):
+    with enable_x64(True):
         if warm is None:
             asg, lvl, floor, gap, converged, rounds, phases, _ = (
                 _solve_cold(

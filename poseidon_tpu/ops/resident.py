@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from poseidon_tpu.compat import enable_x64
 from poseidon_tpu.graph.builder import GraphMeta
 from poseidon_tpu.graph.network import FlowNetwork, pad_bucket
 from poseidon_tpu.models import get_cost_model
@@ -334,6 +335,44 @@ class ResidentOutcome:
     timings: dict[str, float]
 
 
+@dataclasses.dataclass
+class InflightSolve:
+    """A dispatched-but-not-fetched resident round.
+
+    ``begin_round`` returns one of these; the placement download runs
+    on a background thread from the moment of dispatch (the fetch
+    clock starts immediately, so this environment's flat per-sync
+    charge elapses concurrently with whatever host work the caller
+    overlaps). ``finish_round`` joins the fetch and completes the
+    round. Rounds that resolved synchronously (degrade paths) carry
+    ``outcome`` directly.
+    """
+
+    outcome: ResidentOutcome | None = None
+    future: object = None            # Future -> fetched host tuple
+    state: object = None             # device DenseState (warm candidate)
+    cost_dev: object = None          # priced arc table (oracle fallback)
+    arrays: dict | None = None
+    meta: GraphMeta | None = None
+    topo: TransportTopology | None = None
+    dt: object = None                # device DenseTopology
+    inputs_dev: object = None
+    model_fn: object = None
+    n_prefs: int = 0
+    smax: int = 1
+    max_rounds: int = 0
+    warm_used: bool = False
+    Tp: int = 0
+    Mp: int = 0
+    T: int = 0
+    n_machines: int = 0
+    timings: dict | None = None
+    t_dispatch: float = 0.0
+    # set by finish_round on first join; guards double-finish (a
+    # driver's cancel path must not re-run the certificate/fallback)
+    consumed: bool = False
+
+
 class ResidentSolver:
     """Owns the device-resident solve chain + warm state across rounds.
 
@@ -366,6 +405,9 @@ class ResidentSolver:
         self._e_floor = 16
         self._t_floor = 16
         self._m_floor = 16
+        # async placement fetch (one round in flight at a time)
+        self._fetch_pool = None
+        self._inflight = False
 
     def reset(self) -> None:
         self._warm = None
@@ -375,6 +417,15 @@ class ResidentSolver:
         """The on-HBM warm handle carried across rounds (None = cold)."""
         return self._warm
 
+    def _get_fetch_pool(self):
+        if self._fetch_pool is None:
+            import concurrent.futures
+
+            self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="resident-fetch"
+            )
+        return self._fetch_pool
+
     def run_round(
         self,
         arrays: dict[str, np.ndarray],
@@ -382,13 +433,46 @@ class ResidentSolver:
         *,
         cost_model: str,
         cost_input_kwargs: dict | None = None,
+        topology: TransportTopology | None = None,
     ) -> ResidentOutcome:
-        """One full scheduling round from builder host arrays.
+        """One full scheduling round from builder host arrays (serial:
+        ``begin_round`` immediately joined by ``finish_round``).
 
         ``arrays`` is ``FlowGraphBuilder.build_arrays``'s output;
         ``cost_input_kwargs`` are the KnowledgeBase aggregates passed to
-        ``build_cost_inputs_host``.
+        ``build_cost_inputs_host``; ``topology`` (optional) skips the
+        O(arcs) taxonomy re-validation when the caller already derived
+        the skeleton (the incremental builder does).
         """
+        return self.finish_round(self.begin_round(
+            arrays, meta, cost_model=cost_model,
+            cost_input_kwargs=cost_input_kwargs, topology=topology,
+        ))
+
+    def begin_round(
+        self,
+        arrays: dict[str, np.ndarray],
+        meta: GraphMeta,
+        *,
+        cost_model: str,
+        cost_input_kwargs: dict | None = None,
+        topology: TransportTopology | None = None,
+    ) -> InflightSolve:
+        """Prep + upload + async dispatch of one resident round.
+
+        Returns an ``InflightSolve`` whose placement download is already
+        running on a background thread — the caller overlaps host work
+        (next poll parse, delta build, binding POSTs) and then calls
+        ``finish_round``. Degrade paths (small instance, non-taxonomy,
+        HBM envelope) solve synchronously on the oracle and come back
+        with ``outcome`` already set. One round may be in flight at a
+        time; a second ``begin_round`` before ``finish_round`` raises.
+        """
+        if self._inflight:
+            raise RuntimeError(
+                "a resident round is already in flight; finish_round() "
+                "must be called before the next begin_round()"
+            )
         timings: dict[str, float] = {}
         t0 = time.perf_counter()
         # grow-only bucket floors: arc/task counts oscillating across a
@@ -400,24 +484,40 @@ class ResidentSolver:
         inputs_host = build_cost_inputs_host(
             E, meta, **(cost_input_kwargs or {})
         )
-        def degrade(why: str, topo):
-            # price on device (the models want device inputs) and solve
-            # this round on the oracle
-            inputs_dev = jax.device_put(inputs_host)
-            cost = _jitted_model(cost_model)(inputs_dev)
-            return self._oracle_round(
-                arrays, meta, topo, cost, timings, why=why
-            )
 
-        try:
-            topo = extract_topology(
-                meta, arrays["src"], arrays["dst"], arrays["cap"]
+        def degrade(why: str, topo, *, price_on_cpu: bool = False):
+            # price the arcs (the models want device inputs) and solve
+            # this round on the oracle. The small lane prices on the
+            # host CPU backend: the registry models are pure jnp, and a
+            # tiny round whose whole point is "skip the TPU launch
+            # floor" must not pay a TPU device_put + model dispatch
+            # either (ADVICE round 5).
+            cpu = None
+            if price_on_cpu:
+                try:
+                    cpu = jax.local_devices(backend="cpu")[0]
+                except RuntimeError:
+                    cpu = None  # no CPU backend registered: default dev
+            inputs_dev = (
+                jax.device_put(inputs_host, cpu)
+                if cpu is not None else jax.device_put(inputs_host)
             )
-        except NotSchedulingShaped:
-            # not a builder-taxonomy graph: price it anyway (the models
-            # only need the arc metadata) and solve on the oracle, the
-            # same degradation solve_scheduling provides
-            return degrade("not-scheduling-shaped", None)
+            cost = _jitted_model(cost_model)(inputs_dev)
+            return InflightSolve(outcome=self._oracle_round(
+                arrays, meta, topo, cost, timings, why=why
+            ))
+
+        topo = topology
+        if topo is None:
+            try:
+                topo = extract_topology(
+                    meta, arrays["src"], arrays["dst"], arrays["cap"]
+                )
+            except NotSchedulingShaped:
+                # not a builder-taxonomy graph: price it anyway (the
+                # models only need the arc metadata) and solve on the
+                # oracle, the same degradation solve_scheduling provides
+                return degrade("not-scheduling-shaped", None)
         T, P = topo.n_tasks, topo.max_prefs
         from poseidon_tpu.solver import is_small_instance
 
@@ -426,12 +526,13 @@ class ResidentSolver:
             and self.oracle_fallback
             and self._warm is None
             # T == 0 keeps the pre-dedup behavior: an empty round is
-            # trivially "small" and must not pay a TPU compile
+            # trivially "small" and pays neither a TPU compile nor a
+            # TPU pricing dispatch (the small lane prices on CPU)
             and (T == 0 or is_small_instance(T, topo.n_machines))
         ):
             # tiny instance: the subprocess oracle beats the TPU launch
             # floor (solver.SMALL_INSTANCE_* documents the measurement)
-            return degrade("small-instance", topo)
+            return degrade("small-instance", topo, price_on_cpu=True)
         dt_host = pad_topology(
             topo, t_min=self._t_floor, m_min=self._m_floor
         )
@@ -465,14 +566,17 @@ class ResidentSolver:
         )
         timings["prep_ms"] = (time.perf_counter() - t0) * 1000
 
-        # ---- upload + ONE fused program + ONE sync -------------------
+        # ---- upload + ONE fused program + ONE (async) sync -----------
         # The whole device round (cost model → densify → solve →
         # finalize) is a single compiled program (``_resident_chain``,
         # see its docstring for the measured dispatch economics). No
         # intermediate block_until_ready — the program pipelines into
         # the single device_get below, the round's one host sync (a
-        # flat ~100 ms on this link, ~us attached); ``solve_ms`` covers
-        # dispatch + execution + completion.
+        # flat ~100 ms on this link, ~us attached). The download runs
+        # on a background thread starting NOW, so its latency elapses
+        # while the caller does next-round host work; ``solve_ms``
+        # covers dispatch + execution + completion regardless of where
+        # the caller was when it completed.
         t0 = time.perf_counter()
         inputs_dev, dt = jax.device_put((inputs_host, dt_host))
         timings["upload_ms"] = (time.perf_counter() - t0) * 1000
@@ -490,8 +594,8 @@ class ResidentSolver:
         zeros_t = jnp.zeros(Tp, I32)
         zeros_m = jnp.zeros(Mp, I32)
 
-        t0 = time.perf_counter()
-        with jax.enable_x64(True):
+        t_dispatch = time.perf_counter()
+        with enable_x64(True):
             (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d, phases_d,
              ch_dev, primal, domain_ok, cost_dev) = _resident_chain(
                 dt, inputs_dev,
@@ -506,33 +610,100 @@ class ResidentSolver:
             asg=asg_d, lvl=lvl_d, floor=floor_d, gap=gap_d,
             converged=conv_d, rounds=rounds_d, phases=phases_d,
         )
-        asg_np, ch_np, conv, rounds, phases, primal_np, dom_ok = (
-            jax.device_get((
+
+        def _fetch():
+            vals = jax.device_get((
                 state.asg, ch_dev, state.converged, state.rounds,
                 state.phases, primal, domain_ok,
             ))
+            return vals, time.perf_counter()
+
+        self._inflight = True
+        return InflightSolve(
+            future=self._get_fetch_pool().submit(_fetch),
+            state=state,
+            cost_dev=cost_dev,
+            arrays=arrays,
+            meta=meta,
+            topo=topo,
+            dt=dt,
+            inputs_dev=inputs_dev,
+            model_fn=model_fn,
+            n_prefs=P,
+            smax=smax,
+            max_rounds=max_rounds,
+            warm_used=warm is not None,
+            Tp=Tp,
+            Mp=Mp,
+            T=T,
+            n_machines=topo.n_machines,
+            timings=timings,
+            t_dispatch=t_dispatch,
         )
-        timings["solve_ms"] = (time.perf_counter() - t0) * 1000
+
+    def discard_round(self, inflight: InflightSolve) -> None:
+        """Join and drop an in-flight solve the caller is abandoning.
+
+        Unlike ``finish_round`` this never re-certifies: no cold retry,
+        no oracle fallback (which could block for the full oracle
+        timeout inside an error-recovery path) — it only drains the
+        fetch future so the worker thread is idle and the next
+        ``begin_round`` starts clean. Warm state is left as it was.
+        """
+        if inflight.outcome is not None or inflight.consumed:
+            return
+        self._inflight = False
+        inflight.consumed = True
+        try:
+            inflight.future.result()
+        except Exception:
+            log.exception("discard_round: in-flight fetch failed")
+
+    def finish_round(self, inflight: InflightSolve) -> ResidentOutcome:
+        """Join the async placement fetch and complete the round
+        (certificate checks, cold retry, warm-state commit)."""
+        if inflight.outcome is not None:
+            return inflight.outcome
+        self._inflight = False
+        inflight.consumed = True
+        timings = inflight.timings
+        topo = inflight.topo
+        T = inflight.T
+        t0 = time.perf_counter()
+        (asg_np, ch_np, conv, rounds, phases, primal_np, dom_ok), t_done = (
+            inflight.future.result()
+        )
+        # fetch_wait is the part of the sync the caller actually blocked
+        # on; the rest elapsed under overlapped host work
+        timings["fetch_wait_ms"] = (time.perf_counter() - t0) * 1000
+        timings["solve_ms"] = (t_done - inflight.t_dispatch) * 1000
         timings["fetch_ms"] = 0.0
+        state = inflight.state
 
         if not bool(dom_ok):
             self._warm = None
             return self._oracle_round(
-                arrays, meta, topo, cost_dev, timings, why="cost-domain"
+                inflight.arrays, inflight.meta, topo, inflight.cost_dev,
+                timings, why="cost-domain",
             )
-        if not bool(conv) and warm is not None:
+        if not bool(conv) and inflight.warm_used:
             # stale warm start stranded the eps=1 settle: retry cold
             # (its solve + second download land in the same timing
-            # columns — this round really does pay twice)
+            # columns — this round really does pay twice). Synchronous:
+            # the overlap window is gone by the time we know.
             self._warm = None
+            zeros_t = jnp.zeros(inflight.Tp, I32)
+            zeros_m = jnp.zeros(inflight.Mp, I32)
             t0 = time.perf_counter()
-            with jax.enable_x64(True):
+            with enable_x64(True):
                 (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
                  phases_d, ch_dev, primal, _dom, cost_dev) = (
                     _resident_chain(
-                        dt, inputs_dev, zeros_t, zeros_t, zeros_m,
-                        model_fn=model_fn, n_prefs=P, smax=smax,
-                        alpha=self.alpha, max_rounds=max_rounds,
+                        inflight.dt, inflight.inputs_dev, zeros_t,
+                        zeros_t, zeros_m,
+                        model_fn=inflight.model_fn,
+                        n_prefs=inflight.n_prefs, smax=inflight.smax,
+                        alpha=self.alpha, max_rounds=inflight.max_rounds,
                         warm_start=False,
                     )
                 )
@@ -540,6 +711,7 @@ class ResidentSolver:
                 asg=asg_d, lvl=lvl_d, floor=floor_d, gap=gap_d,
                 converged=conv_d, rounds=rounds_d, phases=phases_d,
             )
+            inflight.cost_dev = cost_dev
             asg_np, ch_np, conv, rounds, phases, primal_np = (
                 jax.device_get((
                     state.asg, ch_dev, state.converged, state.rounds,
@@ -550,14 +722,16 @@ class ResidentSolver:
         if not bool(conv):
             self._warm = None
             return self._oracle_round(
-                arrays, meta, topo, cost_dev, timings, why="uncertified"
+                inflight.arrays, inflight.meta, topo, inflight.cost_dev,
+                timings, why="uncertified",
             )
 
         self._warm = state
-        Mp = dt_host.arc_m2s.shape[0]
+        Mp = inflight.Mp
         asg = np.asarray(asg_np[:T], np.int32)
         asg = np.where(
-            (asg >= 0) & (asg < Mp) & (asg < topo.n_machines), asg, -1
+            (asg >= 0) & (asg < Mp) & (asg < inflight.n_machines),
+            asg, -1,
         ).astype(np.int32)
         return ResidentOutcome(
             assignment=asg,
@@ -570,6 +744,7 @@ class ResidentSolver:
             topology=topo,
             timings=timings,
         )
+
 
     def _oracle_round(
         self, arrays, meta, topo, cost_dev, timings, *, why: str

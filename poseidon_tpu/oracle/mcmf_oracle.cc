@@ -558,6 +558,34 @@ int main(int argc, char** argv) {
   for (int v = 0; v < n; ++v)
     if (supply[v] > 0) total_supply += supply[v];
 
+  if (algo == "cs2" || algo == "cost_scaling") {
+    // Both scaling modes start the eps ladder at
+    // eps0 = (maxc+1)*(n+3)*(n+2) (cs2: big=(maxc+1)*(n+3) times
+    // scale=n+2; cost_scaling: big=(maxc+1)*(n_+1) times scale=n_
+    // with n_=n+2 — the same product). Computed in 64-bit that wraps
+    // silently for maxc ~ 2^63/n^2 and the ladder then starts from a
+    // garbage (possibly negative) eps — check the product in 128-bit
+    // and refuse loudly instead, mirroring the alpha < 2 guard below.
+    // abs and +1 in 128-bit: both wrap in int64 at the extremes the
+    // guard exists to refuse (|INT64_MIN| and INT64_MAX + 1)
+    i128 maxc_all = 0;
+    for (auto& a : arcs) {
+      i128 c = (i128)a[3];
+      if (c < 0) c = -c;
+      maxc_all = std::max(maxc_all, c);
+    }
+    i128 eps0_wide = (maxc_all + 1) * (i128)(n + 3) * (i128)(n + 2);
+    if (eps0_wide > (i128)INT64_MAX) {
+      i128 shown = maxc_all > (i128)INT64_MAX ? (i128)INT64_MAX
+                                              : maxc_all;
+      std::fprintf(stderr,
+                   "%s: eps0 = (maxc+1)(n+3)(n+2) overflows int64 "
+                   "(maxc=%lld, n=%d)\n",
+                   algo.c_str(), (long long)shown, n);
+      return 2;
+    }
+  }
+
   if (algo == "cs2") {
     CS2Solver cs2;
     cs2.Init(n + 2);

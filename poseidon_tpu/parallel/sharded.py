@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from poseidon_tpu.compat import enable_x64, shard_map
 from poseidon_tpu.ops.dense_auction import (
     INF,
     DenseInstance,
@@ -123,7 +124,7 @@ def collective_account(
     if max_rounds is None:
         max_rounds = default_fuse()
     asg0, lvl0, floor0, eps0 = cold_start(sharded, alpha)
-    with jax.enable_x64(True):
+    with enable_x64(True):
         compiled = _solve.lower(
             sharded, asg0, lvl0, floor0, eps0, alpha,
             max_rounds, sharded.smax, analytic_init=True,
@@ -183,13 +184,13 @@ def sharded_certificate_gap(
             c, u, task_valid, s, asg, lvl, floor, scale, mesh_axis=axis
         )
 
-    fn = jax.shard_map(
+    fn = shard_map(
         kernel,
         mesh=mesh,
         in_specs=(tm, tv, tv, rp, tv, tv, rp, rp),
         out_specs=rp,
     )
-    with jax.enable_x64(True):
+    with enable_x64(True):
         gap = fn(
             dev.c, dev.u, dev.task_valid, dev.s,
             state.asg, state.lvl, state.floor, dev.scale,

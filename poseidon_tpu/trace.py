@@ -8,7 +8,16 @@ scheduler event, with an injectable clock so tests are deterministic.
 
 Event types mirror the cluster-trace vocabulary: SUBMIT (pod observed),
 SCHEDULE (placement decision), EVICT (node loss), FINISH (pod retired),
-plus ROUND records carrying the per-phase timing/stat payload.
+plus ROUND records carrying the per-phase timing/stat payload
+(``SchedulerStats`` as a dict — including the round-pipeline timers:
+``build_mode`` delta/full/legacy, ``dispatch_ms``, ``fetch_wait_ms``,
+``overlap_ms``, ``wall_ms``; ``total_ms`` is the host critical path,
+excluding the overlap window where the loop worked on other rounds).
+
+Pipelined rounds (bridge ``begin_round``/``finish_round``) emit their
+ROUND record at finish time, so a round's SCHEDULE/ROUND events may
+interleave with the NEXT round's SUBMIT events in the stream; consumers
+must order by ``round_num``, not file position.
 """
 
 from __future__ import annotations

@@ -17,6 +17,8 @@ sys.path.insert(0, ".")
 
 import numpy as np  # noqa: E402
 
+from poseidon_tpu.compat import enable_x64  # noqa: E402
+
 
 def main() -> int:
     import dataclasses as dc
@@ -134,7 +136,7 @@ def main() -> int:
         t_price = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        with jax.enable_x64(True):
+        with enable_x64(True):
             dev, domain_ok, pc_s, ra_s = _redensify(
                 dt, cost, n_prefs=P, smax=smax
             )
@@ -147,7 +149,7 @@ def main() -> int:
         t_solve = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        with jax.enable_x64(True):
+        with enable_x64(True):
             ch_dev, primal = _finalize(dev, dt, pc_s, ra_s, state.asg)
         jax.block_until_ready(ch_dev)
         t_fin = time.perf_counter() - t0

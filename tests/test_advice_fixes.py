@@ -287,3 +287,61 @@ class TestPerturbCostsX64:
             warnings.simplefilter("error", UserWarning)
             res = solve_what_if(inst, n_variants=3, seed=1)
         assert res.converged.all()
+
+
+class TestOracleEps0OverflowGuard:
+    """ADVICE round 5: eps0 = (maxc+1)(n+3)(n+2) is computed in 128-bit
+    and both scaling modes exit(2) instead of silently wrapping."""
+
+    DIMACS_HUGE = "p min 2 1\nn 1 1\nn 2 -1\na 1 2 0 1 {c}\n"
+
+    def _run(self, algo, cost):
+        import subprocess
+
+        from poseidon_tpu.oracle.oracle import _ensure_built
+
+        return subprocess.run(
+            [str(_ensure_built()), algo],
+            input=self.DIMACS_HUGE.format(c=cost),
+            capture_output=True, text=True,
+        )
+
+    @pytest.mark.parametrize("algo", ["cs2", "cost_scaling"])
+    @pytest.mark.parametrize("cost", [2**62, 2**63 - 1, -(2**63)])
+    def test_overflowing_eps0_exits_2(self, algo, cost):
+        # 2**63 - 1 == INT64_MAX exercises the widen-before-+1 detail
+        # ((i128)(maxc+1) would wrap to INT64_MIN and pass); -(2**63)
+        # == INT64_MIN exercises the 128-bit abs (int64 -x is UB there)
+        p = self._run(algo, cost)
+        assert p.returncode == 2
+        assert "overflows int64" in p.stderr
+
+    @pytest.mark.parametrize("algo", ["cs2", "cost_scaling"])
+    def test_large_but_safe_cost_still_solves(self, algo):
+        # (maxc+1)*5*4 just under INT64_MAX for n=2
+        p = self._run(algo, 2**58)
+        assert p.returncode == 0
+        assert p.stdout.startswith("s ")
+
+
+class TestSolveGeneralErrorChain:
+    """ADVICE round 5: the oracle_fallback=False RuntimeError chains the
+    guard's ValueError (raise ... from e)."""
+
+    def test_general_guard_runtimeerror_chains_cause(self):
+        from poseidon_tpu.solver import solve_scheduling
+        from poseidon_tpu.graph.builder import FlowGraphBuilder
+        import dataclasses as dc
+
+        # a non-taxonomy graph whose capacities trip the general
+        # backend's excess-wrap precheck (int32 accumulator guard)
+        huge = 2**31 - 1
+        net = FlowNetwork.from_arrays(
+            [0, 1], [1, 2], [huge, huge], [1, 1], [huge, 0, -huge]
+        )
+        rng = np.random.default_rng(5)
+        cluster = random_cluster(rng, 4, 8)
+        _, meta = FlowGraphBuilder().build(cluster)
+        with pytest.raises(RuntimeError) as ei:
+            solve_scheduling(net, meta, oracle_fallback=False)
+        assert isinstance(ei.value.__cause__, ValueError)

@@ -141,3 +141,149 @@ class TestLifecycle:
         bridge.observe_pods(_pods(6))  # same pending set
         r2 = bridge.run_scheduler()
         assert r2.stats.cost == r1.stats.cost
+
+
+class TestPipelinedEquivalence:
+    """Pipelined rounds (begin/finish with overlapped observations)
+    must produce the same bindings and certified-exact costs as serial
+    rounds over the same observation stream."""
+
+    def _obs_stream(self, rounds):
+        """Deterministic per-round arrivals: (round -> new pods)."""
+        out = []
+        for r in range(rounds):
+            out.append([
+                Task(
+                    uid=f"p{r}-{i}", job=f"j{r}-{i // 3}",
+                    cpu_request=0.25,
+                    memory_request_kb=1 << 12,
+                    data_prefs={f"m{(r + i) % 5}": 60 + i},
+                )
+                for i in range(4 + (r % 3))
+            ])
+        return out
+
+    def _snapshot(self, bridge, done):
+        return [
+            dataclasses.replace(t, phase=TaskPhase.SUCCEEDED)
+            if t.uid in done else t
+            for t in bridge.tasks.values()
+        ]
+
+    def _drive(self, pipelined, *, incremental=True, rounds=6):
+        bridge = SchedulerBridge(
+            cost_model="quincy", incremental_build=incremental
+        )
+        bridge.observe_nodes(_machines(5, slots=3))
+        stream = self._obs_stream(rounds)
+        results = []
+        inflight = None
+        for r in range(rounds):
+            # pods placed two rounds ago finish now (available in both
+            # modes: round r-2 has been finished by the time round r's
+            # snapshot is taken, even pipelined)
+            done = set(results[r - 2].bindings) if r >= 2 else set()
+            bridge.observe_pods(
+                self._snapshot(bridge, done) + stream[r]
+            )
+            if pipelined:
+                if inflight is not None:
+                    res = bridge.finish_round(inflight)
+                    for uid, m in res.bindings.items():
+                        bridge.confirm_binding(uid, m)
+                    results.append(res)
+                inflight = bridge.begin_round()
+            else:
+                res = bridge.run_scheduler()
+                for uid, m in res.bindings.items():
+                    bridge.confirm_binding(uid, m)
+                results.append(res)
+        if inflight is not None:
+            res = bridge.finish_round(inflight)
+            for uid, m in res.bindings.items():
+                bridge.confirm_binding(uid, m)
+            results.append(res)
+        return results
+
+    def test_same_bindings_and_costs(self):
+        serial = self._drive(False)
+        piped = self._drive(True)
+        assert len(serial) == len(piped)
+        for s, p in zip(serial, piped):
+            assert s.bindings == p.bindings
+            assert s.stats.cost == p.stats.cost
+            assert sorted(s.unscheduled) == sorted(p.unscheduled)
+            assert s.stats.pods_placed == p.stats.pods_placed
+
+    def test_pipelined_equivalent_without_incremental_build(self):
+        serial = self._drive(False, incremental=True)
+        piped = self._drive(True, incremental=False)
+        for s, p in zip(serial, piped):
+            assert s.bindings == p.bindings
+            assert s.stats.cost == p.stats.cost
+
+    def test_double_begin_raises(self):
+        bridge = SchedulerBridge(cost_model="trivial")
+        bridge.observe_nodes(_machines(2))
+        bridge.observe_pods(_pods(3))
+        ir = bridge.begin_round()
+        try:
+            import pytest
+
+            with pytest.raises(RuntimeError):
+                bridge.begin_round()
+        finally:
+            bridge.finish_round(ir)
+
+    def test_revoke_binding_reoffers_pod(self):
+        """Optimistic confirm + failed POST: revoke flips the pod back
+        to pending and the next round re-places it."""
+        bridge = SchedulerBridge(cost_model="trivial")
+        bridge.observe_nodes(_machines(2))
+        bridge.observe_pods(_pods(2))
+        r1 = bridge.run_scheduler()
+        uid, machine = next(iter(r1.bindings.items()))
+        bridge.confirm_binding(uid, machine)
+        bridge.revoke_binding(uid)
+        assert bridge.tasks[uid].phase == TaskPhase.PENDING
+        r2 = bridge.run_scheduler()
+        assert uid in r2.bindings
+
+    def test_stale_placement_dropped_when_pod_moved_midflight(self):
+        """A pod the overlap window's poll adopted as Running elsewhere
+        (another scheduler, watch catch-up) must NOT come back in the
+        in-flight round's bindings — that would clobber observed truth
+        with a conflicting bind POST."""
+        bridge = SchedulerBridge(cost_model="trivial")
+        bridge.observe_nodes(_machines(3))
+        bridge.observe_pods(_pods(2))
+        ir = bridge.begin_round()
+        # overlap window: the poll reports p0 already Running on m2
+        moved = dataclasses.replace(
+            _pods(2)[0], phase=TaskPhase.RUNNING, machine="m2"
+        )
+        bridge.observe_pods([moved, _pods(2)[1]])
+        res = bridge.finish_round(ir)
+        assert "p0" not in res.bindings
+        assert "p0" not in res.unscheduled
+        assert bridge.tasks["p0"].machine == "m2"
+        # the still-pending pod's placement goes through normally
+        assert "p1" in res.bindings
+
+    def test_placement_on_vanished_machine_ages_as_unscheduled(self):
+        """A placement whose target node disappeared during the overlap
+        window is dropped AND accounted: the pod ages and shows up in
+        unscheduled, like any other pod the round left behind."""
+        bridge = SchedulerBridge(cost_model="trivial")
+        bridge.observe_nodes(_machines(2, slots=4))
+        bridge.observe_pods(_pods(3))
+        ir = bridge.begin_round()
+        # overlap window: every node vanishes (small cluster, no
+        # shrink-hold at this size)
+        bridge.observe_nodes([])
+        res = bridge.finish_round(ir)
+        assert res.bindings == {}
+        assert sorted(res.unscheduled) == ["p0", "p1", "p2"]
+        assert res.stats.pods_unscheduled == 3
+        for uid in ("p0", "p1", "p2"):
+            assert bridge.tasks[uid].wait_rounds == 1

@@ -1,5 +1,6 @@
 """L1b differential tests: JAX cost-scaling solver vs the C++ oracle."""
 
+from poseidon_tpu.compat import enable_x64
 import numpy as np
 import pytest
 
@@ -120,7 +121,7 @@ class TestWhatIfBatching:
         # zero the padding cost slots to stay consistent
         costs[:, int(base.n_arcs):] = 0
 
-        with jax.enable_x64(True):
+        with enable_x64(True):
             batched = jax.vmap(
                 lambda c: _solve(base.with_costs(c), 20000, 8)
             )(jnp.asarray(costs))

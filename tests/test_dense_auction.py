@@ -10,6 +10,7 @@ clusters).
 
 from collections import Counter
 
+from poseidon_tpu.compat import enable_x64
 import numpy as np
 import pytest
 
@@ -227,7 +228,7 @@ class TestHistDebugPath:
         net = price(net, meta, "quincy", cluster)
         dev = build_dense_instance(extract_instance(net, meta))
         asg0, lvl0, floor0, eps0 = cold_start(dev)
-        with jax.enable_x64(True):
+        with enable_x64(True):
             out = _solve(
                 dev, asg0, lvl0, floor0, eps0, 1024, 20_000,
                 dev.smax, analytic_init=True, collect_hist=True,

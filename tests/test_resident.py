@@ -1,6 +1,7 @@
 """Device-resident round (ops/resident.py): exactness, warm reuse,
 domain fallback, transfer discipline."""
 
+from poseidon_tpu.compat import enable_x64
 import numpy as np
 import pytest
 
@@ -270,7 +271,7 @@ class TestRedensifyMatchesHostDensify:
             jax.tree_util.tree_map(jnp.asarray, inputs)
         )
         dt = jax.device_put(pad_topology(topo))
-        with jax.enable_x64(True):
+        with enable_x64(True):
             dev, domain_ok, _, _ = _redensify(
                 dt, cost, n_prefs=topo.max_prefs, smax=host_dev.smax
             )

@@ -18,7 +18,10 @@ BASELINE config-2 flagship (Quincy, 1k machines / 10k pods) and
 instance (target: value < 50 ms, vs_baseline >= 20, BASELINE.md).
 Per-config detail rows (all phases, costs, convergence) ride along in
 the same JSON object under "configs"; human-readable progress goes to
-stderr so stdout stays machine-parseable.
+stderr so stdout stays machine-parseable. Config 6 (rebalance_drift)
+measures the rebalancing subsystem: place-only vs rebalanced final-
+packing cost gap against the oracle optimum, migrations per round
+under the churn budget, and serial-vs-pipelined delta equivalence.
 """
 
 from __future__ import annotations
@@ -85,7 +88,6 @@ def bench_config(
     )
     cost_fn = get_cost_model(model)
     costs = np.asarray(cost_fn(inputs))  # warm the jit before timing
-    t2 = time.perf_counter()
     prices = []
     for _ in range(max(solve_reps, 2)):
         ta = time.perf_counter()
@@ -848,13 +850,128 @@ def bench_trace_replay(
     return row
 
 
+def bench_rebalance(
+    *, n_machines: int = 48, n_running: int = 120, rounds: int = 10,
+    budget: int = 16, seed: int = 0,
+) -> dict:
+    """Config 6: rebalancing vs place-only over a drifted cluster.
+
+    Replays the same drifted snapshot (``synth.config6_rebalance``:
+    running pods crowded far from their data) through three bridges —
+    place-only, rebalancing serial, rebalancing pipelined — and
+    reports: the final packing's cost gap vs the oracle optimum of the
+    same instance (the status-quo ``assignment_cost`` minus the oracle
+    solve) per mode, migrations/preemptions per round against the
+    churn budget, and whether the pipelined rounds applied exactly the
+    serial rounds' deltas.
+    """
+    from poseidon_tpu.bridge import SchedulerBridge
+    from poseidon_tpu.graph.builder import FlowGraphBuilder
+    from poseidon_tpu.models import build_cost_inputs, get_cost_model
+    from poseidon_tpu.oracle import solve_oracle
+    from poseidon_tpu.ops.transport import (
+        assignment_cost,
+        extract_instance,
+    )
+    from poseidon_tpu.synth import config6_rebalance
+
+    HYST = 20
+
+    def drive(enable: bool, pipelined: bool):
+        cluster = config6_rebalance(n_machines, n_running, seed=seed)
+        br = SchedulerBridge(
+            cost_model="quincy",
+            enable_preemption=enable,
+            migration_hysteresis=HYST,
+            max_migrations_per_round=budget,
+        )
+        br.observe_nodes(cluster.machines)
+        br.observe_pods(cluster.tasks)
+        results = []
+        inflight = None
+
+        def apply(res):
+            for uid, m in res.bindings.items():
+                br.confirm_binding(uid, m)
+            for uid, (_frm, to) in res.migrations.items():
+                br.confirm_migration(uid, to)
+            for uid in res.preemptions:
+                br.confirm_preemption(uid)
+            results.append(res)
+
+        for _ in range(rounds):
+            if pipelined:
+                if inflight is not None:
+                    apply(br.finish_round(inflight))
+                inflight = br.begin_round()
+            else:
+                apply(br.run_scheduler())
+        if inflight is not None:
+            apply(br.finish_round(inflight))
+        return br, results
+
+    def final_gap(br) -> tuple[int, int]:
+        """(status-quo cost, oracle optimum) of the final packing,
+        both priced over the same rebalancing instance."""
+        fb = FlowGraphBuilder(
+            preemption=True, migration_hysteresis=HYST
+        )
+        net, meta = fb.build(br.cluster_state())
+        net = net.with_costs(
+            get_cost_model("quincy")(build_cost_inputs(net, meta))
+        )
+        inst = extract_instance(net, meta)
+        sq = assignment_cost(inst, meta.task_current)
+        opt = int(solve_oracle(net, algorithm="cost_scaling").cost)
+        return sq, opt
+
+    log("bench: config 6 place-only replay ...")
+    br_po, _ = drive(False, False)
+    log("bench: config 6 rebalancing serial replay ...")
+    br_rb, res_s = drive(True, False)
+    log("bench: config 6 rebalancing pipelined replay ...")
+    _, res_p = drive(True, True)
+
+    sq_po, opt_po = final_gap(br_po)
+    sq_rb, opt_rb = final_gap(br_rb)
+    pipelined_equal = len(res_s) == len(res_p) and all(
+        s.bindings == p.bindings
+        and s.migrations == p.migrations
+        and s.preemptions == p.preemptions
+        and s.stats.cost == p.stats.cost
+        for s, p in zip(res_s, res_p)
+    )
+    disruptive = [
+        s.stats.deltas_migrate + s.stats.deltas_preempt for s in res_s
+    ]
+    return {
+        "config": "rebalance_drift",
+        "machines": n_machines,
+        "running": n_running,
+        "rounds": rounds,
+        "budget": budget,
+        # the headline: how far each mode's final packing sits above
+        # the oracle optimum of the same priced instance
+        "place_only_gap_vs_oracle": sq_po - opt_po,
+        "rebalanced_gap_vs_oracle": sq_rb - opt_rb,
+        "migrations_per_round": [
+            s.stats.deltas_migrate for s in res_s
+        ],
+        "preempts_total": sum(s.stats.deltas_preempt for s in res_s),
+        "deferred_total": sum(s.stats.deltas_deferred for s in res_s),
+        "budget_respected": all(d <= budget for d in disruptive),
+        "pipelined_deltas_equal": pipelined_equal,
+        "backends": sorted({s.stats.backend for s in res_s}),
+    }
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--configs",
-        default="1,2,3,4,5",
-        help="comma list of BASELINE config numbers to run",
+        default="1,2,3,4,5,6",
+        help="comma list of BASELINE config numbers to run "
+             "(6 = the rebalancing drift-correction config)",
     )
     ap.add_argument("--solve-reps", type=int, default=20)
     ap.add_argument("--oracle-reps", type=int, default=3)
@@ -900,6 +1017,20 @@ def main() -> int:
                 log(f"bench: config 4 FAILED:\n{traceback.format_exc()}")
                 rows.append(
                     {"config": "trace_replay_12k", "config_num": 4,
+                     "error": True}
+                )
+            continue
+        if num == 6:
+            log("bench: running config 6 (rebalance_drift) ...")
+            try:
+                row = bench_rebalance()
+                row["config_num"] = 6
+                rows.append(row)
+                log(f"bench: config 6 done: {json.dumps(row)}")
+            except Exception:
+                log(f"bench: config 6 FAILED:\n{traceback.format_exc()}")
+                rows.append(
+                    {"config": "rebalance_drift", "config_num": 6,
                      "error": True}
                 )
             continue

@@ -295,3 +295,30 @@ class K8sApiClient:
         except ApiError as e:
             log.error("binding %s -> %s failed: %s", pod, node, e)
             return False
+
+    # ---- evictions -----------------------------------------------------
+
+    def evict_pod(self, pod: str, namespace: str = "default") -> bool:
+        """POST the Eviction subresource that unbinds a running pod.
+
+        The actuation half of the rebalancing deltas the reference
+        never implemented: MIGRATE = evict_pod + bind_pod_to_node,
+        PREEMPT = evict_pod alone (the pod parks Pending and is
+        re-offered with its aging preserved). ``pod`` accepts the same
+        bare-or-qualified forms as ``bind_pod_to_node``.
+        """
+        if "/" in pod:
+            namespace, pod = pod.split("/", 1)
+        body = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": pod, "namespace": namespace},
+        }
+        try:
+            self._request(
+                f"namespaces/{namespace}/pods/{pod}/eviction", body
+            )
+            return True
+        except ApiError as e:
+            log.error("eviction of %s failed: %s", pod, e)
+            return False

@@ -11,6 +11,10 @@ comments (k8s_api_client.cc:96-99) — but never builds the fixture
   pod's ``spec.nodeName`` is set and its phase flips to Running on the
   NEXT poll (bindings are acknowledged before they are observable, like
   the real control plane).
+- ``POST /api/v1/namespaces/{ns}/pods/{name}/eviction`` — unbinds the
+  pod: ``spec.nodeName`` is cleared and its phase flips back to Pending
+  on the NEXT poll. Evictions and bindings are applied in POST order,
+  so a MIGRATE (evict + re-bind) lands as one visible move.
 
 Fault injection for resilience tests: ``fail_next(n)`` makes the next n
 requests return HTTP 500; ``drop_node(name)`` removes a node between
@@ -39,7 +43,9 @@ class FakeApiServer:
         self.nodes: dict[str, dict] = {}
         self.pods: dict[str, dict] = {}
         self.bindings: list[tuple[str, str]] = []
-        self._pending_bindings: list[tuple[str, str]] = []
+        self.evictions: list[str] = []
+        # bind/evict ops applied in POST order on the next pods poll
+        self._pending_ops: list[tuple[str, str, str]] = []
         self._fail_next = 0
         self._truncate = 0
         self.requests_served = 0
@@ -111,9 +117,23 @@ class FakeApiServer:
                         if node not in server.nodes:
                             self._reply(404, {"error": f"no node {node}"})
                             return
-                        server._pending_bindings.append((key, node))
+                        server._pending_ops.append(("bind", key, node))
                         server.bindings.append((key, node))
                         self._reply(201, {"status": "Bound"})
+                    # api/v1/namespaces/{ns}/pods/{name}/eviction
+                    elif (
+                        len(parts) == 7
+                        and parts[2] == "namespaces"
+                        and parts[4] == "pods"
+                        and parts[6] == "eviction"
+                    ):
+                        key = f"{parts[3]}/{parts[5]}"
+                        if key not in server.pods:
+                            self._reply(404, {"error": f"no pod {key}"})
+                            return
+                        server._pending_ops.append(("evict", key, ""))
+                        server.evictions.append(key)
+                        self._reply(201, {"status": "Evicted"})
                     else:
                         self._reply(404, {"error": self.path})
 
@@ -173,13 +193,20 @@ class FakeApiServer:
         return doc
 
     def _apply_pending(self) -> None:
-        """Bindings become observable on the next pods poll."""
-        for pod, node in self._pending_bindings:
+        """Bindings/evictions become observable on the next pods poll,
+        applied in POST order (a MIGRATE's evict + re-bind collapses to
+        one visible move)."""
+        for op, pod, node in self._pending_ops:
             doc = self.pods.get(pod)
-            if doc is not None:
+            if doc is None:
+                continue
+            if op == "bind":
                 doc.setdefault("spec", {})["nodeName"] = node
                 doc.setdefault("status", {})["phase"] = "Running"
-        self._pending_bindings.clear()
+            else:  # evict
+                doc.setdefault("spec", {}).pop("nodeName", None)
+                doc.setdefault("status", {})["phase"] = "Pending"
+        self._pending_ops.clear()
 
     def add_node(
         self,

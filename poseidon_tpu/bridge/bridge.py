@@ -57,6 +57,20 @@ transition the bridge applies is mirrored into an
 ``IncrementalFlowGraphBuilder`` note, and ``begin_round`` patches the
 previous round's builder columns instead of re-walking every task
 object (``incremental_build=False`` restores the legacy full rebuild).
+
+Rebalancing (``enable_preemption=True``): running tasks enter the flow
+graph with a hysteresis-discounted continuation arc and a priced
+unscheduled arc (graph/builder.py rebalancing mode), and each round's
+solved assignment is diffed against current placements into typed
+``PLACE | MIGRATE | PREEMPT | NOOP`` deltas (graph/deltas.py) under a
+per-round ``max_migrations_per_round`` churn budget. The bridge emits
+the decisions (``RoundResult.migrations`` / ``.preemptions``); the
+driver actuates them against the apiserver (MIGRATE = eviction POST +
+re-bind, PREEMPT = eviction POST) and reports back through
+``confirm_migration`` / ``confirm_preemption`` / ``restore_running``,
+mirroring the existing ``confirm_binding`` / ``revoke_binding``
+contract for PLACE. With the flag off, behavior is byte-identical to
+place-only scheduling.
 """
 
 from __future__ import annotations
@@ -73,6 +87,7 @@ from poseidon_tpu.graph.builder import (
     FlowGraphBuilder,
     IncrementalFlowGraphBuilder,
 )
+from poseidon_tpu.graph.deltas import extract_deltas
 from poseidon_tpu.models.knowledge import (
     KnowledgeBase,
     MachineSample,
@@ -114,6 +129,17 @@ class SchedulerStats:
     pods_placed: int = 0
     pods_unscheduled: int = 0
     evictions: int = 0
+    # rebalancing delta counts (graph/deltas.py vocabulary; all zero in
+    # place-only mode except deltas_place == pods_placed)
+    deltas_place: int = 0
+    deltas_migrate: int = 0
+    deltas_preempt: int = 0
+    deltas_noop: int = 0
+    deltas_deferred: int = 0
+    # placement/migration POSTs the driver reported failed since the
+    # previous round (the pods were re-queued, not silently believed
+    # placed)
+    bind_failures: int = 0
     cost: int = 0
     backend: str = ""
     build_ms: float = 0.0
@@ -130,11 +156,17 @@ class SchedulerStats:
 
 @dataclasses.dataclass
 class RoundResult:
-    """One scheduling round's output: bindings to POST + stats."""
+    """One scheduling round's output: deltas to actuate + stats."""
 
     bindings: dict[str, str]          # pod uid -> machine name (new PLACEs)
     stats: SchedulerStats
     unscheduled: list[str]            # pods left pending this round
+    # rebalancing decisions (empty in place-only mode): the driver
+    # actuates these against the apiserver and confirms back
+    migrations: dict[str, tuple[str, str]] = dataclasses.field(
+        default_factory=dict)         # uid -> (from_machine, to_machine)
+    preemptions: dict[str, str] = dataclasses.field(
+        default_factory=dict)         # uid -> from_machine
 
 
 @dataclasses.dataclass
@@ -164,9 +196,15 @@ class SchedulerBridge:
         solver_timeout_s: float = 1000.0,
         small_to_oracle: bool = True,
         incremental_build: bool = True,
+        enable_preemption: bool = False,
+        migration_hysteresis: int = 20,
+        max_migrations_per_round: int = 64,
     ):
         self.cost_model = cost_model
         self.max_tasks_per_machine = max_tasks_per_machine
+        self.enable_preemption = enable_preemption
+        self.migration_hysteresis = migration_hysteresis
+        self.max_migrations_per_round = max_migrations_per_round
         self.trace = trace or TraceGenerator()
         self.knowledge = KnowledgeBase(queue_size=sample_queue_size)
         self.machines: dict[str, Machine] = {}
@@ -183,14 +221,22 @@ class SchedulerBridge:
         # mirrored as a note; begin_round patches instead of rebuilding
         self.incremental_build = incremental_build
         self._graph = (
-            IncrementalFlowGraphBuilder() if incremental_build else None
+            IncrementalFlowGraphBuilder(
+                preemption=enable_preemption,
+                migration_hysteresis=migration_hysteresis,
+            )
+            if incremental_build else None
         )
         # bounded: a daemon running forever must not grow without bound
-        # (full history goes to the trace stream when a sink is set)
-        self.decision_log: collections.deque[tuple[int, str, str]] = (
-            collections.deque(maxlen=100_000)
-        )
+        # (full history goes to the trace stream when a sink is set).
+        # Entries are (round_num, kind, uid, detail) where detail is
+        # the machine (PLACE), "from->to" (MIGRATE), or the evicted-
+        # from machine (PREEMPT).
+        self.decision_log: collections.deque[
+            tuple[int, str, str, str]
+        ] = collections.deque(maxlen=100_000)
         self._evictions_this_round = 0
+        self._bind_failures = 0
         # consecutive implausible-shrink polls (mass-eviction guard)
         self._node_shrink_strikes = 0
         self._pod_shrink_strikes = 0
@@ -369,6 +415,15 @@ class SchedulerBridge:
                         "adopting running pod %s on %s",
                         pod.uid, pod.machine,
                     )
+                # the poll carries no aging (wait_rounds is bridge-
+                # internal): preserve it so a later preemption parks
+                # the pod with its starvation pressure intact
+                stored = (
+                    dataclasses.replace(
+                        pod, wait_rounds=known.wait_rounds
+                    )
+                    if known is not None else pod
+                )
                 if g:
                     if known is not None and known.phase == TaskPhase.PENDING:
                         g.note_task_removed(pod.uid)
@@ -377,12 +432,16 @@ class SchedulerBridge:
                         if known is not None
                         and known.phase == TaskPhase.RUNNING else ""
                     )
-                    if was_on != pod.machine:
+                    if self.enable_preemption:
+                        self._running_reobserved(
+                            known, pod, stored, was_on
+                        )
+                    elif was_on != pod.machine:
                         if was_on and was_on in self.machines:
                             g.note_slots_changed(was_on, -1)
                         if pod.machine:
                             g.note_slots_changed(pod.machine, +1)
-                self.tasks[pod.uid] = pod
+                self.tasks[pod.uid] = stored
                 if pod.machine:
                     self.pod_to_machine[pod.uid] = pod.machine
                 self.knowledge.add_task_sample(
@@ -415,6 +474,38 @@ class SchedulerBridge:
             self.pod_to_machine.pop(uid, None)
             self.knowledge.retire_task(uid)
 
+    def _running_reobserved(
+        self, known: Task | None, pod: Task, stored: Task, was_on: str
+    ) -> None:
+        """Rebalancing-mode graph notes for a pod observed RUNNING.
+
+        The running block keys on (uid, machine, job, prefs): machine
+        changes patch as moves, cpu/mem as updates, job/pref reshapes
+        force a rebuild (they change arc structure mid-order).
+        """
+        g = self._graph
+        if known is None or known.phase != TaskPhase.RUNNING \
+                or not was_on:
+            # entering the running block (adoption, pending->running,
+            # or a Running pod that previously lacked a nodeName)
+            if pod.machine:
+                g.note_running_added(stored)
+            return
+        if not pod.machine:
+            g.note_running_removed(pod.uid)
+            return
+        if known.job != pod.job or not (
+            known.data_prefs is pod.data_prefs
+            or known.data_prefs == pod.data_prefs
+        ):
+            g.note_full_rebuild("running pod reshaped")
+            return
+        if was_on != pod.machine:
+            g.note_running_moved(pod.uid, pod.machine)
+        if (known.cpu_request != pod.cpu_request
+                or known.memory_request_kb != pod.memory_request_kb):
+            g.note_running_updated(stored)
+
     def _retire_notes(self, task: Task) -> None:
         """Graph notes for a task leaving the cluster entirely."""
         g = self._graph
@@ -424,7 +515,10 @@ class SchedulerBridge:
             g.note_task_removed(task.uid)
         elif (task.phase == TaskPhase.RUNNING
               and task.machine in self.machines):
-            g.note_slots_changed(task.machine, -1)
+            if self.enable_preemption:
+                g.note_running_removed(task.uid)
+            else:
+                g.note_slots_changed(task.machine, -1)
 
     # ---- the scheduling round ------------------------------------------
 
@@ -457,13 +551,21 @@ class SchedulerBridge:
         stats = SchedulerStats(round_num=self.round_num)
         stats.evictions = self._evictions_this_round
         self._evictions_this_round = 0
+        stats.bind_failures = self._bind_failures
+        self._bind_failures = 0
         t_start = time.perf_counter()
 
         cluster = self.cluster_state()
         pending = cluster.pending()
         stats.pods_total = len(cluster.tasks)
         stats.pods_pending = len(pending)
-        if not self.machines or not pending:
+        # rebalancing rounds run on running tasks alone — correcting a
+        # drifted packing needs no pending arrivals
+        has_rebal = self.enable_preemption and any(
+            t.phase == TaskPhase.RUNNING and t.machine in self.machines
+            for t in cluster.tasks
+        )
+        if not self.machines or (not pending and not has_rebal):
             stats.total_ms = (time.perf_counter() - t_start) * 1000
             stats.wall_ms = stats.total_ms
             self.trace.emit(
@@ -482,34 +584,54 @@ class SchedulerBridge:
         if self._graph is not None:
             arrays, meta = self._graph.build_arrays(cluster, pending)
             stats.build_mode = self._graph.last_build_mode
-            topology = topology_from_columns(self._graph.columns)
+            cols = self._graph.columns
+            topology = topology_from_columns(cols)
             cpu_col, mem_col = self._graph.cost_columns()
         else:
-            arrays, meta = FlowGraphBuilder().build_arrays(cluster)
-            stats.build_mode = "legacy"
-            cpu_col = np.array(
-                [int(t.cpu_request * 1000) for t in pending]
+            fb = FlowGraphBuilder(
+                preemption=self.enable_preemption,
+                migration_hysteresis=self.migration_hysteresis,
             )
-            mem_col = np.array([t.memory_request_kb for t in pending])
+            cols = fb.merge_columns(fb.extract_columns(cluster))
+            arrays, meta = fb.assemble(cols)
+            stats.build_mode = "legacy"
+            cpu_col, mem_col = cols.cpu_milli, cols.mem_kb
         stats.build_ms = (time.perf_counter() - t0) * 1000
 
         machine_names = meta.machine_names
+        cost_kwargs = dict(
+            task_cpu_milli=cpu_col,
+            task_mem_kb=mem_col,
+            task_usage=self.knowledge.task_cpu_usage(
+                meta.task_uids
+            ),
+            machine_load=self.knowledge.machine_load(machine_names),
+            machine_mem_free=self.knowledge.machine_mem_free(
+                machine_names
+            ),
+        )
+        if self.enable_preemption:
+            # rebalancing needs the models to see the CURRENT packing:
+            # occupancy (running tasks per machine) is what makes a
+            # drifted machine expensive and a migration worth its
+            # hysteresis. Gated on the flag so place-only pricing stays
+            # byte-identical to the pre-rebalancing scheduler. Derived
+            # from the merged builder columns (current_m), not a Python
+            # walk of cluster.tasks — this path is O(churn) + numpy.
+            cur = cols.current_m
+            cost_kwargs["machine_used_slots"] = (
+                np.bincount(
+                    cur[cur >= 0], minlength=len(machine_names)
+                ).astype(np.int32)
+                if cur is not None
+                else np.zeros(len(machine_names), np.int32)
+            )
         t0 = time.perf_counter()
         solve = self.solver.begin_round(
             arrays, meta,
             cost_model=self.cost_model,
             topology=topology,
-            cost_input_kwargs=dict(
-                task_cpu_milli=cpu_col,
-                task_mem_kb=mem_col,
-                task_usage=self.knowledge.task_cpu_usage(
-                    meta.task_uids
-                ),
-                machine_load=self.knowledge.machine_load(machine_names),
-                machine_mem_free=self.knowledge.machine_mem_free(
-                    machine_names
-                ),
-            ),
+            cost_input_kwargs=cost_kwargs,
         )
         t_end = time.perf_counter()
         stats.dispatch_ms = (t_end - t0) * 1000
@@ -554,16 +676,36 @@ class SchedulerBridge:
         stats.backend = outcome.backend
         stats.cost = outcome.cost
 
-        names = meta.machine_names
-        placements = {
-            uid: (names[m] if m >= 0 else None)
-            for uid, m in zip(meta.task_uids, outcome.assignment)
-        }
+        # the decision layer: diff the solved assignment against current
+        # placements into typed PLACE | MIGRATE | PREEMPT | NOOP records
+        # (graph/deltas.py), budget-bounded in rebalancing mode. In
+        # place-only mode every task is pending, so this reduces to the
+        # old place-or-age classification exactly.
+        dset = extract_deltas(
+            meta, outcome.assignment,
+            max_migrations=(
+                self.max_migrations_per_round
+                if self.enable_preemption else 0
+            ),
+        )
 
         bindings: dict[str, str] = {}
         unscheduled: list[str] = []
+        migrations: dict[str, tuple[str, str]] = {}
+        preemptions: dict[str, str] = {}
         g = self._graph
-        for uid, machine in placements.items():
+
+        def _age(uid: str, task: Task) -> None:
+            # aging: parked pods push harder next round (the
+            # Quincy/CoCo unscheduled-cost input)
+            self.tasks[uid] = dataclasses.replace(
+                task, wait_rounds=task.wait_rounds + 1
+            )
+            if g:
+                g.note_task_aged(uid)
+            unscheduled.append(uid)
+
+        def _live_pending(uid: str) -> Task | None:
             task = self.tasks.get(uid)
             if task is None or task.phase != TaskPhase.PENDING:
                 # the overlap window's poll already moved this pod —
@@ -571,37 +713,85 @@ class SchedulerBridge:
                 # scheduler / watch catch-up). The in-flight decision
                 # is stale for it: binding it would clobber observed
                 # truth with a conflicting POST, aging it would age a
-                # pod that is not waiting. Skip; a still-pending pod is
-                # simply re-offered next round.
+                # pod that is not waiting. Skip; a still-pending pod
+                # is simply re-offered next round.
+                return None
+            return task
+
+        for d in dset.place:
+            task = _live_pending(d.task)
+            if task is None:
                 continue
-            if machine is not None and machine not in self.machines:
+            if d.machine not in self.machines:
                 # the target machine disappeared during the overlap
                 # window (node removal): confirming would park the pod
                 # Running on a ghost. Treat the pod as unplaced — it
                 # ages and is reported unscheduled like any other
                 # pending pod this round left behind (the node removal
                 # already forced a full rebuild).
-                machine = None
-            if machine is None:
-                # aging: parked pods push harder next round (the
-                # Quincy/CoCo unscheduled-cost input)
-                self.tasks[uid] = dataclasses.replace(
-                    task, wait_rounds=task.wait_rounds + 1
-                )
-                if g:
-                    g.note_task_aged(uid)
-                unscheduled.append(uid)
-            else:
-                bindings[uid] = machine
-                self.decision_log.append((self.round_num, uid, machine))
-                self.trace.emit("SCHEDULE", task=uid, machine=machine,
-                                round_num=ir.stats.round_num)
-                log.info(
-                    "round %d: PLACE %s -> %s",
-                    ir.stats.round_num, uid, machine,
-                )
+                _age(d.task, task)
+                continue
+            bindings[d.task] = d.machine
+            self.decision_log.append(
+                (self.round_num, "PLACE", d.task, d.machine)
+            )
+            self.trace.emit("SCHEDULE", task=d.task, machine=d.machine,
+                            round_num=ir.stats.round_num)
+            log.info(
+                "round %d: PLACE %s -> %s",
+                ir.stats.round_num, d.task, d.machine,
+            )
+        for uid in dset.unscheduled:
+            task = _live_pending(uid)
+            if task is not None:
+                _age(uid, task)
+        for d in dset.migrate:
+            task = self.tasks.get(d.task)
+            if (task is None or task.phase != TaskPhase.RUNNING
+                    or task.machine != d.from_machine
+                    or d.machine not in self.machines):
+                # stale: the pod moved/retired during the overlap
+                # window, or the target node vanished — re-proposed
+                # next round if still worthwhile
+                continue
+            migrations[d.task] = (d.from_machine, d.machine)
+            self.decision_log.append((
+                self.round_num, "MIGRATE", d.task,
+                f"{d.from_machine}->{d.machine}",
+            ))
+            self.trace.emit(
+                "MIGRATE", task=d.task, machine=d.machine,
+                round_num=ir.stats.round_num,
+                detail={"from": d.from_machine},
+            )
+            log.info(
+                "round %d: MIGRATE %s %s -> %s", ir.stats.round_num,
+                d.task, d.from_machine, d.machine,
+            )
+        for d in dset.preempt:
+            task = self.tasks.get(d.task)
+            if (task is None or task.phase != TaskPhase.RUNNING
+                    or task.machine != d.from_machine):
+                continue
+            preemptions[d.task] = d.from_machine
+            self.decision_log.append(
+                (self.round_num, "PREEMPT", d.task, d.from_machine)
+            )
+            self.trace.emit(
+                "PREEMPT", task=d.task, machine=d.from_machine,
+                round_num=ir.stats.round_num,
+            )
+            log.info(
+                "round %d: PREEMPT %s off %s", ir.stats.round_num,
+                d.task, d.from_machine,
+            )
         stats.pods_placed = len(bindings)
         stats.pods_unscheduled = len(unscheduled)
+        stats.deltas_place = len(bindings)
+        stats.deltas_migrate = len(migrations)
+        stats.deltas_preempt = len(preemptions)
+        stats.deltas_noop = len(dset.noop)
+        stats.deltas_deferred = len(dset.deferred)
         t_now = time.perf_counter()
         stats.total_ms = ir.begin_ms + (t_now - t_fin) * 1000
         stats.wall_ms = (t_now - ir.t_begin_start) * 1000
@@ -611,7 +801,8 @@ class SchedulerBridge:
         )
         self.trace.flush()
         return RoundResult(
-            bindings=bindings, stats=stats, unscheduled=unscheduled
+            bindings=bindings, stats=stats, unscheduled=unscheduled,
+            migrations=migrations, preemptions=preemptions,
         )
 
     def cancel_round(self, ir: InflightRound | None = None) -> None:
@@ -658,19 +849,26 @@ class SchedulerBridge:
         task = self.tasks.get(uid)
         if task is None:
             return
+        stored = dataclasses.replace(
+            task, phase=TaskPhase.RUNNING, machine=machine
+        )
         g = self._graph
         if g:
             if task.phase == TaskPhase.PENDING:
                 g.note_task_removed(uid)
-                g.note_slots_changed(machine, +1)
+                if self.enable_preemption:
+                    g.note_running_added(stored)
+                else:
+                    g.note_slots_changed(machine, +1)
             elif task.phase == TaskPhase.RUNNING and \
                     task.machine != machine:
-                if task.machine and task.machine in self.machines:
-                    g.note_slots_changed(task.machine, -1)
-                g.note_slots_changed(machine, +1)
-        self.tasks[uid] = dataclasses.replace(
-            task, phase=TaskPhase.RUNNING, machine=machine
-        )
+                if self.enable_preemption:
+                    g.note_running_moved(uid, machine)
+                else:
+                    if task.machine and task.machine in self.machines:
+                        g.note_slots_changed(task.machine, -1)
+                    g.note_slots_changed(machine, +1)
+        self.tasks[uid] = stored
         self.pod_to_machine[uid] = machine
 
     def revoke_binding(self, uid: str) -> None:
@@ -688,3 +886,75 @@ class SchedulerBridge:
         self.pod_to_machine.pop(uid, None)
         if self._graph:
             self._graph.note_full_rebuild("binding revoked")
+
+    def confirm_migration(self, uid: str, machine: str) -> None:
+        """Driver reports a MIGRATE actuated (eviction + re-bind POSTs
+        landed): move the running task to its new machine."""
+        task = self.tasks.get(uid)
+        if task is None:
+            return
+        g = self._graph
+        if g:
+            if task.phase == TaskPhase.RUNNING:
+                if task.machine != machine:
+                    g.note_running_moved(uid, machine)
+            else:
+                g.note_full_rebuild("migration of non-running pod")
+        self.tasks[uid] = dataclasses.replace(
+            task, phase=TaskPhase.RUNNING, machine=machine
+        )
+        self.pod_to_machine[uid] = machine
+
+    def confirm_preemption(self, uid: str) -> None:
+        """Driver reports a PREEMPT actuated (eviction POST landed):
+        park the pod Pending with its aging preserved. The pod re-enters
+        the pending order mid-sequence, so the next graph build is a
+        full rebuild."""
+        task = self.tasks.get(uid)
+        if task is None:
+            return
+        self.tasks[uid] = dataclasses.replace(
+            task, phase=TaskPhase.PENDING, machine=""
+        )
+        self.pod_to_machine.pop(uid, None)
+        if self._graph:
+            self._graph.note_full_rebuild("preempted back to pending")
+
+    def restore_running(self, uid: str, machine: str) -> None:
+        """An eviction/re-bind POST failed (possibly after an optimistic
+        ``confirm_migration``/``confirm_preemption``): restore the pod
+        to RUNNING on ``machine`` — the apiserver's last-known truth —
+        count the failure, and force a full rebuild. If the eviction
+        half of a migration did land, the next poll re-observes the true
+        state and reconciles."""
+        self._bind_failures += 1
+        task = self.tasks.get(uid)
+        if task is None:
+            return
+        self.tasks[uid] = dataclasses.replace(
+            task, phase=TaskPhase.RUNNING, machine=machine
+        )
+        self.pod_to_machine[uid] = machine
+        if self._graph:
+            self._graph.note_full_rebuild("actuation failed")
+
+    def binding_failed(self, uid: str) -> None:
+        """A bindings POST for a PLACE failed: count it and re-queue the
+        pod as unscheduled — aging preserved and bumped like any other
+        round it sat waiting — instead of silently believing the
+        placement landed. Handles both the serial path (pod still
+        Pending, never confirmed) and the optimistic pipelined path
+        (pod confirmed Running first: revoked, then aged)."""
+        self._bind_failures += 1
+        task = self.tasks.get(uid)
+        if task is None:
+            return
+        if task.phase == TaskPhase.RUNNING:
+            self.revoke_binding(uid)
+            task = self.tasks[uid]
+        if task.phase == TaskPhase.PENDING:
+            self.tasks[uid] = dataclasses.replace(
+                task, wait_rounds=task.wait_rounds + 1
+            )
+            if self._graph:
+                self._graph.note_task_aged(uid)

@@ -84,6 +84,24 @@ def build_parser() -> argparse.ArgumentParser:
                    default="true", choices=["true", "false"],
                    help="O(churn) delta graph builds across rounds; "
                         "false = full rebuild every round")
+    # rebalancing: the full SchedulingDelta vocabulary (PLACE /
+    # MIGRATE / PREEMPT / NOOP) — running pods get a hysteresis-
+    # discounted continuation arc and a priced unscheduled arc, and
+    # the solver may move or park them whenever the global cost
+    # improves by more than the hysteresis
+    p.add_argument("--enable_preemption",
+                   default="false", choices=["true", "false"],
+                   help="let rounds MIGRATE/PREEMPT running pods "
+                        "(rebalancing); false = place-only, byte-"
+                        "identical to the pre-rebalancing scheduler")
+    p.add_argument("--migration_hysteresis", type=int, default=20,
+                   help="cost discount on a running pod's continuation "
+                        "arc: a migration must improve the objective "
+                        "by more than this to be proposed")
+    p.add_argument("--max_migrations_per_round", type=int, default=64,
+                   help="churn budget: MIGRATE+PREEMPT deltas actuated "
+                        "per round (0 = unlimited); excess deltas are "
+                        "deferred and re-proposed next round")
     p.add_argument("--max_solver_runtime", type=int,
                    default=1_000_000_000,
                    help="microseconds; bounds one oracle-fallback solve "
@@ -185,6 +203,38 @@ def _post_bindings(client, bridge, bindings: dict[str, str]):
         return list(pool.map(_bind, bindings.items()))
 
 
+def _actuate_rebalance(client, bridge, migrations, preemptions, *,
+                       confirm: bool):
+    """Actuate MIGRATE (evict + re-bind) and PREEMPT (evict) deltas.
+
+    ``confirm=True`` is the serial contract (state changes only after
+    the POSTs land); ``confirm=False`` the optimistic pipelined one
+    (the bridge already confirmed at finish time — failures restore the
+    pod to its old machine and the next poll reconciles).
+    """
+    def _ns(uid):
+        task = bridge.tasks.get(uid)
+        return task.namespace if task else "default"
+
+    for uid, frm in preemptions.items():
+        if client.evict_pod(uid, namespace=_ns(uid)):
+            if confirm:
+                bridge.confirm_preemption(uid)
+        else:
+            log.warning("eviction POST failed for %s; restoring", uid)
+            bridge.restore_running(uid, frm)
+    for uid, (frm, to) in migrations.items():
+        ns = _ns(uid)
+        ok = client.evict_pod(uid, namespace=ns) and \
+            client.bind_pod_to_node(uid, to, namespace=ns)
+        if ok:
+            if confirm:
+                bridge.confirm_migration(uid, to)
+        else:
+            log.warning("migration POSTs failed for %s; restoring", uid)
+            bridge.restore_running(uid, frm)
+
+
 def run_loop(args: argparse.Namespace) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -211,6 +261,9 @@ def run_loop(args: argparse.Namespace) -> int:
         trace=trace,
         solver_timeout_s=args.max_solver_runtime / 1e6,
         incremental_build=args.incremental_build == "true",
+        enable_preemption=args.enable_preemption == "true",
+        migration_hysteresis=args.migration_hysteresis,
+        max_migrations_per_round=args.max_migrations_per_round,
     )
     incremental = args.run_incremental_scheduler == "true"
     pipelined = args.round_pipeline == "true"
@@ -218,9 +271,10 @@ def run_loop(args: argparse.Namespace) -> int:
 
     rounds = 0
     # round-pipeline state: at most one solve in flight across ticks,
-    # plus the finished-but-not-yet-POSTed bindings of the last round
+    # plus the finished-but-not-yet-POSTed deltas of the last round
     inflight = None
     to_post: dict[str, str] = {}
+    to_rebal: tuple[dict, dict] = ({}, {})
 
     def _log_round(result):
         s = result.stats
@@ -237,22 +291,35 @@ def run_loop(args: argparse.Namespace) -> int:
             stats_fh.flush()
 
     def _post_and_revoke(to_post):
-        """POST optimistically-confirmed bindings; revoke failures so
-        the pods are re-offered next round."""
+        """POST optimistically-confirmed bindings; failures re-queue
+        the pod as unscheduled (counted in SchedulerStats) so it is
+        re-offered next round."""
         for uid, machine, ok in _post_bindings(client, bridge, to_post):
             if not ok:
-                log.warning("bind POST failed for %s; revoking", uid)
-                bridge.revoke_binding(uid)
+                log.warning("bind POST failed for %s; re-queueing", uid)
+                bridge.binding_failed(uid)
 
-    def _round_done(result, pending_posts):
+    def _flush_pending():
+        """POST any deltas still queued from the last finished round."""
+        nonlocal to_post, to_rebal
+        if to_post:
+            _post_and_revoke(to_post)
+            to_post = {}
+        if to_rebal[0] or to_rebal[1]:
+            _actuate_rebalance(
+                client, bridge, to_rebal[0], to_rebal[1], confirm=False
+            )
+            to_rebal = ({}, {})
+
+    def _round_done(result, flush):
         """Log + count one completed round; True = max_rounds reached
-        (any not-yet-POSTed bindings are flushed before exiting)."""
+        (any not-yet-POSTed deltas are flushed before exiting)."""
         nonlocal rounds
         _log_round(result)
         rounds += 1
         if args.max_rounds and rounds >= args.max_rounds:
-            if pending_posts:
-                _post_and_revoke(pending_posts)
+            if flush:
+                _flush_pending()
             return True
         return False
 
@@ -275,17 +342,25 @@ def run_loop(args: argparse.Namespace) -> int:
                     # finish the solve dispatched last tick (its fetch
                     # ran while we slept/polled/observed), then start
                     # this tick's round and POST the finished round's
-                    # bindings while the new solve is in flight
+                    # deltas while the new solve is in flight
                     if inflight is not None:
                         result = bridge.finish_round(inflight)
                         inflight = None
-                        # optimistic confirm: the next build discounts
-                        # the slots now; the POST follows below and a
-                        # failure revokes (re-offered next round)
+                        # optimistic confirm: the next build sees the
+                        # new placements now; the POSTs follow below
+                        # and a failure re-queues/restores
                         for uid, machine in result.bindings.items():
                             bridge.confirm_binding(uid, machine)
+                        for uid, (_frm, to) in result.migrations.items():
+                            bridge.confirm_migration(uid, to)
+                        for uid in result.preemptions:
+                            bridge.confirm_preemption(uid)
                         to_post = dict(result.bindings)
-                        if _round_done(result, to_post):
+                        to_rebal = (
+                            dict(result.migrations),
+                            dict(result.preemptions),
+                        )
+                        if _round_done(result, True):
                             return 0
                     if not incremental:
                         # must happen AFTER finish_round (which commits
@@ -294,15 +369,13 @@ def run_loop(args: argparse.Namespace) -> int:
                         bridge.warm_state = None
                     ir = bridge.begin_round()
                     if ir.result is not None:
-                        # empty round (nothing pending): completed
+                        # empty round (nothing schedulable): completed
                         # synchronously, nothing in flight
-                        if _round_done(ir.result, to_post):
+                        if _round_done(ir.result, True):
                             return 0
                     else:
                         inflight = ir
-                    if to_post:
-                        _post_and_revoke(to_post)
-                        to_post = {}
+                    _flush_pending()
                 else:
                     result = bridge.run_scheduler()
                     if result.bindings:
@@ -311,7 +384,14 @@ def run_loop(args: argparse.Namespace) -> int:
                         ):
                             if ok:
                                 bridge.confirm_binding(uid, machine)
-                    if _round_done(result, None):
+                            else:
+                                bridge.binding_failed(uid)
+                    if result.migrations or result.preemptions:
+                        _actuate_rebalance(
+                            client, bridge, result.migrations,
+                            result.preemptions, confirm=True,
+                        )
+                    if _round_done(result, False):
                         return 0
             except Exception:
                 # a failed round (oracle timeout, device fault) must not
@@ -320,16 +400,14 @@ def run_loop(args: argparse.Namespace) -> int:
                 if inflight is not None:
                     bridge.cancel_round(inflight)
                     inflight = None
-                if to_post:
-                    # bindings confirmed before the failure must still
-                    # reach the apiserver — a persistently failing
-                    # begin_round must not strand them Running-locally
-                    # / Pending-remotely forever
-                    try:
-                        _post_and_revoke(to_post)
-                    except Exception:
-                        log.exception("deferred binding POSTs failed")
-                    to_post = {}
+                # deltas confirmed before the failure must still reach
+                # the apiserver — a persistently failing begin_round
+                # must not strand them Running-locally /
+                # Pending-remotely forever
+                try:
+                    _flush_pending()
+                except Exception:
+                    log.exception("deferred delta POSTs failed")
                 time.sleep(args.polling_frequency / 1e6)
                 continue
             elapsed = time.perf_counter() - tick_start

@@ -1,4 +1,14 @@
 from poseidon_tpu.graph.network import FlowNetwork, pad_bucket
 from poseidon_tpu.graph.builder import FlowGraphBuilder, NodeRole, ArcKind
+from poseidon_tpu.graph.deltas import (
+    DeltaKind,
+    DeltaSet,
+    SchedulingDelta,
+    extract_deltas,
+)
 
-__all__ = ["FlowNetwork", "pad_bucket", "FlowGraphBuilder", "NodeRole", "ArcKind"]
+__all__ = [
+    "FlowNetwork", "pad_bucket", "FlowGraphBuilder", "NodeRole",
+    "ArcKind", "DeltaKind", "DeltaSet", "SchedulingDelta",
+    "extract_deltas",
+]

@@ -29,6 +29,22 @@ on anything it cannot patch (machine-set changes, mid-order pending
 re-inserts). Because both paths share ``assemble``, a delta build is
 bit-identical to a from-scratch build by construction; the differential
 suite in tests/test_incremental.py asserts it anyway.
+
+Rebalancing mode (``preemption=True``, the Firmament semantics behind
+``SchedulingDelta::MIGRATE``/``PREEMPT``): RUNNING tasks enter the
+graph as schedulable task nodes instead of merely discounting machine
+slots. Each running task gets (a) a *continuation* arc to its current
+machine — structurally an ordinary ``TASK_TO_MACHINE`` preference arc
+(so the transportation form and the dense kernel apply unchanged)
+carrying a ``migration_hysteresis`` discount the cost layer subtracts,
+(b) the usual wildcard/preference arcs (the migration destinations),
+and (c) a priced unscheduled arc whose selection means PREEMPT (the
+cost layer overlays the preemption penalty). The running block is kept
+in uid-sorted order, separate from the pending block, so O(churn)
+patches never shift pending positions; running tasks route their
+unsched arcs through per-job aggregators of their own (``run:<job>``)
+— aggregator→sink arcs cost 0 under every registry model, so the split
+is cost-neutral while keeping the two blocks independently patchable.
 """
 
 from __future__ import annotations
@@ -82,7 +98,12 @@ class GraphMeta:
     arc_rack: np.ndarray      # int32[n_arcs]  rack index or -1
     arc_weight: np.ndarray    # int32[n_arcs]  data-locality weight (pref
                               # arcs; 0 elsewhere) — Quincy's input
+    arc_discount: np.ndarray  # int32[n_arcs]  hysteresis discount
+                              # (continuation arcs; 0 elsewhere)
     task_wait: np.ndarray     # int32[n_tasks] rounds each task has waited
+    task_current: np.ndarray  # int32[n_tasks] current machine of a
+                              # RUNNING task, -1 for pending — what the
+                              # delta extractor diffs assignments against
     task_node: np.ndarray     # int32[n_tasks] node id of each task
     machine_node: np.ndarray  # int32[n_machines]
     node_machine: np.ndarray  # int32[n_nodes] machine index or -1
@@ -123,6 +144,37 @@ class BuilderColumns:
     pref_w: np.ndarray        # int32[Ep] locality weight
     cpu_milli: np.ndarray     # int64[T] requested milli-cores
     mem_kb: np.ndarray        # int64[T] requested memory
+    # Rebalancing block (preemption mode): RUNNING tasks in uid-sorted
+    # order, kept separate from the pending block so O(churn) patches
+    # on either block never shift the other's positions. Empty in
+    # place-only mode. ``merge_columns`` flattens this block into the
+    # canonical task sequence (pending first, then running) before
+    # assembly / topology derivation.
+    run_uids: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, object))   # object[Rt]
+    run_job: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, object))   # object[Rt]
+    run_machine: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int32))  # int32[Rt]
+    run_wait: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int32))  # int32[Rt]
+    run_cpu: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int64))  # int64[Rt]
+    run_mem: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int64))  # int64[Rt]
+    run_pref_counts: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int64))  # int64[Rt]
+    run_pref_m: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int32))  # int32[Erp]
+    run_pref_r: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int32))  # int32[Erp]
+    run_pref_w: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int32))  # int32[Erp]
+    # Merged-view extras, set by ``merge_columns`` only (None on the
+    # patchable form): current machine per task (-1 = pending) and the
+    # per-pref-row hysteresis discount.
+    current_m: np.ndarray | None = None   # int32[T]
+    pref_d: np.ndarray | None = None      # int32[Ep]
 
 
 class FlowGraphBuilder:
@@ -131,11 +183,27 @@ class FlowGraphBuilder:
     ``pref_arcs`` controls whether task data-preference arcs (Quincy-style)
     are emitted; the trivial cost model routes everything through the
     cluster aggregator like Firmament's TrivialCostModel does.
+
+    ``preemption`` turns on rebalancing mode: RUNNING tasks become
+    schedulable nodes with a continuation arc to their current machine
+    (discounted by ``migration_hysteresis``) and a priced unscheduled
+    arc, so the solver may keep, migrate, or preempt them. Machine
+    slots are then NOT discounted for running tasks — they hold their
+    seats through their own unit of flow.
     """
 
-    def __init__(self, *, pref_arcs: bool = True, rack_aggs: bool = True):
+    def __init__(
+        self,
+        *,
+        pref_arcs: bool = True,
+        rack_aggs: bool = True,
+        preemption: bool = False,
+        migration_hysteresis: int = 20,
+    ):
         self.pref_arcs = pref_arcs
         self.rack_aggs = rack_aggs
+        self.preemption = preemption
+        self.migration_hysteresis = int(migration_hysteresis)
 
     def build(self, cluster: ClusterState) -> tuple[FlowNetwork, GraphMeta]:
         """Build and upload the padded device FlowNetwork + metadata."""
@@ -201,13 +269,56 @@ class FlowGraphBuilder:
         # Slots already consumed by RUNNING tasks: the reference tracks
         # running tasks against --max_tasks_per_pu inside Firmament; we
         # discount machine capacity here so re-offered slots are real.
+        # In rebalancing mode running tasks are schedulable nodes and
+        # hold their seats through their own unit of flow, so slots
+        # stay undiscounted.
         used_slots = np.zeros(len(machines), dtype=np.int64)
-        running = [
-            midx[t.machine] for t in cluster.tasks
-            if t.phase == TaskPhase.RUNNING and t.machine in midx
-        ]
-        if running:
-            np.add.at(used_slots, running, 1)
+        run_block: dict = {}
+        if self.preemption:
+            running_tasks = sorted(
+                (t for t in cluster.tasks
+                 if t.phase == TaskPhase.RUNNING and t.machine in midx),
+                key=lambda t: t.uid,
+            )
+            per_run = [
+                self._task_prefs(t, midx, rack_idx) for t in running_tasks
+            ]
+            run_trip = [row for rows in per_run for row in rows]
+            run_block = dict(
+                run_uids=np.array(
+                    [t.uid for t in running_tasks], dtype=object
+                ),
+                run_job=np.array(
+                    [t.job_id for t in running_tasks], dtype=object
+                ),
+                run_machine=np.array(
+                    [midx[t.machine] for t in running_tasks], np.int32
+                ),
+                run_wait=np.array(
+                    [t.wait_rounds for t in running_tasks], np.int32
+                ),
+                run_cpu=np.array(
+                    [int(t.cpu_request * 1000) for t in running_tasks],
+                    np.int64,
+                ),
+                run_mem=np.array(
+                    [t.memory_request_kb for t in running_tasks],
+                    np.int64,
+                ),
+                run_pref_counts=np.array(
+                    [len(rows) for rows in per_run], np.int64
+                ),
+                run_pref_m=np.array([x[0] for x in run_trip], np.int32),
+                run_pref_r=np.array([x[1] for x in run_trip], np.int32),
+                run_pref_w=np.array([x[2] for x in run_trip], np.int32),
+            )
+        else:
+            running = [
+                midx[t.machine] for t in cluster.tasks
+                if t.phase == TaskPhase.RUNNING and t.machine in midx
+            ]
+            if running:
+                np.add.at(used_slots, running, 1)
 
         per_task = [self._task_prefs(t, midx, rack_idx) for t in tasks]
         trip = [row for rows in per_task for row in rows]
@@ -243,6 +354,90 @@ class FlowGraphBuilder:
             mem_kb=np.array(
                 [t.memory_request_kb for t in tasks], np.int64
             ),
+            **run_block,
+        )
+
+    # ---- stage 1.5: flatten the running block (pure numpy) ------------
+
+    def merge_columns(self, cols: BuilderColumns) -> BuilderColumns:
+        """Flatten the rebalancing block into the canonical task order.
+
+        Returns ``cols`` unchanged when there is no running block (or it
+        is already merged), so place-only mode pays nothing. Running
+        tasks follow the pending block; each contributes its
+        continuation row (current machine, weight 0, hysteresis
+        discount) as its FIRST preference row, then its data prefs;
+        their unsched aggregators are per-job but namespaced
+        (``run:<job>``) so the two blocks stay independently patchable
+        — aggregator→sink arcs cost 0 under every registry model, so
+        the split is cost-neutral.
+        """
+        Rt = len(cols.run_uids)
+        if cols.current_m is not None or Rt == 0:
+            return cols
+        T, J = len(cols.uids), len(cols.jobs)
+        # running-block jobs: first occurrence among uid-sorted tasks
+        rj, first, inv = np.unique(
+            cols.run_job, return_index=True, return_inverse=True
+        )
+        order = np.argsort(first, kind="stable")
+        rank = np.empty(len(order), np.int32)
+        rank[order] = np.arange(len(order), dtype=np.int32)
+        run_job_idx = rank[inv].astype(np.int32)
+        run_jobs = rj[order]
+        run_job_counts = np.bincount(
+            run_job_idx, minlength=len(run_jobs)
+        ).astype(np.int64)
+        # continuation rows, inserted as each task's first pref row
+        starts = np.zeros(Rt, np.int64)
+        if Rt > 1:
+            starts[1:] = np.cumsum(cols.run_pref_counts)[:-1]
+        h = np.int32(self.migration_hysteresis)
+        n_rp = len(cols.run_pref_m)
+        pref_m2 = np.insert(cols.run_pref_m, starts, cols.run_machine)
+        pref_r2 = np.insert(
+            cols.run_pref_r, starts, np.full(Rt, -1, np.int32)
+        )
+        pref_w2 = np.insert(
+            cols.run_pref_w, starts, np.zeros(Rt, np.int32)
+        )
+        pref_d2 = np.insert(
+            np.zeros(n_rp, np.int32), starts, np.full(Rt, h, np.int32)
+        )
+        return dataclasses.replace(
+            cols,
+            uids=np.concatenate([cols.uids, cols.run_uids]),
+            jobs=np.concatenate([
+                cols.jobs,
+                np.array([f"run:{j}" for j in run_jobs], dtype=object),
+            ]),
+            job_idx=np.concatenate([cols.job_idx, run_job_idx + J]),
+            job_counts=np.concatenate([cols.job_counts, run_job_counts]),
+            wait=np.concatenate([cols.wait, cols.run_wait]),
+            pref_counts=np.concatenate(
+                [cols.pref_counts, cols.run_pref_counts + 1]
+            ),
+            pref_m=np.concatenate([cols.pref_m, pref_m2]),
+            pref_r=np.concatenate([cols.pref_r, pref_r2]),
+            pref_w=np.concatenate([cols.pref_w, pref_w2]),
+            cpu_milli=np.concatenate([cols.cpu_milli, cols.run_cpu]),
+            mem_kb=np.concatenate([cols.mem_kb, cols.run_mem]),
+            current_m=np.concatenate([
+                np.full(T, -1, np.int32), cols.run_machine,
+            ]),
+            pref_d=np.concatenate([
+                np.zeros(len(cols.pref_m), np.int32), pref_d2,
+            ]),
+            run_uids=np.zeros(0, object),
+            run_job=np.zeros(0, object),
+            run_machine=np.zeros(0, np.int32),
+            run_wait=np.zeros(0, np.int32),
+            run_cpu=np.zeros(0, np.int64),
+            run_mem=np.zeros(0, np.int64),
+            run_pref_counts=np.zeros(0, np.int64),
+            run_pref_m=np.zeros(0, np.int32),
+            run_pref_r=np.zeros(0, np.int32),
+            run_pref_w=np.zeros(0, np.int32),
         )
 
     # ---- stage 2: columns -> arc families + meta (pure numpy) ---------
@@ -250,6 +445,7 @@ class FlowGraphBuilder:
     def assemble(
         self, cols: BuilderColumns
     ) -> tuple[dict[str, np.ndarray], GraphMeta]:
+        cols = self.merge_columns(cols)
         M, T = len(cols.machine_names), len(cols.uids)
         R, J = len(cols.racks), len(cols.jobs)
         # node layout
@@ -288,6 +484,14 @@ class FlowGraphBuilder:
 
         p_t = np.repeat(t_ids, cols.pref_counts)
         p_m, p_r, p_w = cols.pref_m, cols.pref_r, cols.pref_w
+        p_d = (
+            cols.pref_d if cols.pref_d is not None
+            else np.zeros(len(p_m), np.int32)
+        )
+        current_m = (
+            cols.current_m if cols.current_m is not None
+            else np.full(T, -1, np.int32)
+        )
         is_mp = p_m >= 0
 
         m_ids = np.arange(M, dtype=np.int32)
@@ -298,7 +502,8 @@ class FlowGraphBuilder:
         m_rack = cols.m_rack
         has_rack = m_rack >= 0
 
-        def fam(n, s, d, c, k, ti=None, mi=None, ri=None, wt=None):
+        def fam(n, s, d, c, k, ti=None, mi=None, ri=None, wt=None,
+                dc=None):
             neg1 = np.full(n, -1, np.int32)
             return (
                 np.broadcast_to(np.asarray(s, np.int32), (n,)),
@@ -310,6 +515,8 @@ class FlowGraphBuilder:
                 neg1 if ri is None else np.asarray(ri, np.int32),
                 np.zeros(n, np.int32) if wt is None
                 else np.asarray(wt, np.int32),
+                np.zeros(n, np.int32) if dc is None
+                else np.asarray(dc, np.int32),
             )
 
         families = [
@@ -319,10 +526,12 @@ class FlowGraphBuilder:
                 ti=t_ids),
             fam(int(is_mp.sum()), task_base + p_t[is_mp],
                 machine_base + p_m[is_mp], 1, ArcKind.TASK_TO_MACHINE,
-                ti=p_t[is_mp], mi=p_m[is_mp], wt=p_w[is_mp]),
+                ti=p_t[is_mp], mi=p_m[is_mp], wt=p_w[is_mp],
+                dc=p_d[is_mp]),
             fam(int((~is_mp).sum()), task_base + p_t[~is_mp],
                 rack_base + p_r[~is_mp], 1, ArcKind.TASK_TO_RACK,
-                ti=p_t[~is_mp], ri=p_r[~is_mp], wt=p_w[~is_mp]),
+                ti=p_t[~is_mp], ri=p_r[~is_mp], wt=p_w[~is_mp],
+                dc=p_d[~is_mp]),
             fam(M, CLUSTER, m_nodes, slots, ArcKind.CLUSTER_TO_MACHINE,
                 mi=m_ids),
             fam(int(has_rack.sum()), rack_base + m_rack[has_rack],
@@ -335,7 +544,8 @@ class FlowGraphBuilder:
                 job_task_count.astype(np.int32),
                 ArcKind.UNSCHED_TO_SINK),
         ]
-        src, dst, cap, kind, a_task, a_machine, a_rack, a_weight = (
+        (src, dst, cap, kind, a_task, a_machine, a_rack, a_weight,
+         a_discount) = (
             np.concatenate(cols_) for cols_ in zip(*families)
         )
 
@@ -352,7 +562,9 @@ class FlowGraphBuilder:
             arc_machine=a_machine,
             arc_rack=a_rack,
             arc_weight=a_weight,
+            arc_discount=a_discount,
             task_wait=cols.wait,
+            task_current=current_m,
             task_node=np.arange(task_base, task_base + T, dtype=np.int32),
             machine_node=np.arange(machine_base, machine_base + M,
                                    dtype=np.int32),
@@ -394,21 +606,41 @@ class IncrementalFlowGraphBuilder:
     wrong graph.
     """
 
-    def __init__(self, *, pref_arcs: bool = True, rack_aggs: bool = True):
+    def __init__(
+        self,
+        *,
+        pref_arcs: bool = True,
+        rack_aggs: bool = True,
+        preemption: bool = False,
+        migration_hysteresis: int = 20,
+    ):
         self.builder = FlowGraphBuilder(
-            pref_arcs=pref_arcs, rack_aggs=rack_aggs
+            pref_arcs=pref_arcs, rack_aggs=rack_aggs,
+            preemption=preemption,
+            migration_hysteresis=migration_hysteresis,
         )
         self._cols: BuilderColumns | None = None
+        self._merged: BuilderColumns | None = None
         self._uid_pos: dict[str, int] = {}
         self._added: dict[str, Task] = {}
         self._removed: set[str] = set()
         self._updated: dict[str, Task] = {}
         self._aged: collections.Counter[str] = collections.Counter()
         self._slot_delta: collections.Counter[str] = collections.Counter()
+        # running-block buffers (rebalancing mode)
+        self._run_pos: dict[str, int] = {}
+        self._run_added: dict[str, Task] = {}
+        self._run_removed: set[str] = set()
+        self._run_moved: dict[str, str] = {}
+        self._run_updated: dict[str, Task] = {}
         self._rebuild: str | None = "cold"
         self.last_build_mode = ""
         self.builds_full = 0
         self.builds_delta = 0
+
+    @property
+    def preemption(self) -> bool:
+        return self.builder.preemption
 
     # ---- churn notifications (all O(1)) -------------------------------
 
@@ -420,6 +652,10 @@ class IncrementalFlowGraphBuilder:
             self._updated.clear()
             self._aged.clear()
             self._slot_delta.clear()
+            self._run_added.clear()
+            self._run_removed.clear()
+            self._run_moved.clear()
+            self._run_updated.clear()
 
     def note_task_added(self, task: Task) -> None:
         """A NEW pending pod appended at the end of the pending order."""
@@ -466,21 +702,89 @@ class IncrementalFlowGraphBuilder:
         self._aged[uid] += rounds
 
     def note_slots_changed(self, machine: str, delta: int) -> None:
-        """A machine's RUNNING-task count changed by ``delta``."""
-        if self._rebuild is not None:
+        """A machine's RUNNING-task count changed by ``delta``.
+
+        Rebalancing mode ignores slot deltas: running tasks hold their
+        seats through their own unit of flow, so capacity stays full.
+        """
+        if self._rebuild is not None or self.preemption:
             return
         self._slot_delta[machine] += delta
+
+    # ---- running-block notifications (rebalancing mode, all O(1)) -----
+
+    def note_running_added(self, task: Task) -> None:
+        """A task entered the RUNNING set (confirm/adoption)."""
+        if self._rebuild is not None:
+            return
+        uid = task.uid
+        if uid in self._run_pos or uid in self._run_added \
+                or uid in self._run_removed:
+            # duplicates / re-adds inside one window would need a
+            # remove+insert ordering the sorted merge cannot replay
+            self.note_full_rebuild("running re-add")
+            return
+        if not task.machine:
+            self.note_full_rebuild("running add without machine")
+            return
+        self._run_added[uid] = task
+
+    def note_running_removed(self, uid: str) -> None:
+        """A task left the RUNNING set (retired, preempted, evicted)."""
+        if self._rebuild is not None:
+            return
+        if uid in self._run_added:
+            del self._run_added[uid]
+            self._run_moved.pop(uid, None)
+            self._run_updated.pop(uid, None)
+            return
+        if uid in self._run_pos:
+            self._run_removed.add(uid)
+            self._run_moved.pop(uid, None)
+            self._run_updated.pop(uid, None)
+            return
+        self.note_full_rebuild("unknown running removal")
+
+    def note_running_moved(self, uid: str, machine: str) -> None:
+        """A RUNNING task's machine changed (migration applied)."""
+        if self._rebuild is not None:
+            return
+        if uid in self._run_added:
+            self._run_added[uid] = dataclasses.replace(
+                self._run_added[uid], machine=machine
+            )
+        elif uid in self._run_pos and uid not in self._run_removed:
+            self._run_moved[uid] = machine
+        else:
+            self.note_full_rebuild("unknown running move")
+
+    def note_running_updated(self, task: Task) -> None:
+        """A RUNNING task's cpu/mem request changed in place (same
+        uid, machine, job + prefs)."""
+        if self._rebuild is not None:
+            return
+        uid = task.uid
+        if uid in self._run_added:
+            self._run_added[uid] = task
+        elif uid in self._run_pos and uid not in self._run_removed:
+            self._run_updated[uid] = task
+        else:
+            self.note_full_rebuild("unknown running update")
 
     # ---- build --------------------------------------------------------
 
     @property
     def columns(self) -> BuilderColumns | None:
-        return self._cols
+        """The last build's MERGED columns (identical to the patchable
+        columns in place-only mode, where the merge is the identity)."""
+        return self._merged if self._merged is not None else self._cols
 
     def cost_columns(self) -> tuple[np.ndarray, np.ndarray]:
-        """(task_cpu_milli, task_mem_kb) for the current pending order."""
-        assert self._cols is not None
-        return self._cols.cpu_milli, self._cols.mem_kb
+        """(task_cpu_milli, task_mem_kb) for the current task order
+        (pending, then the running block in rebalancing mode)."""
+        cols = self.columns
+        assert cols is not None
+        return cols.cpu_milli, cols.mem_kb
 
     def build_arrays(
         self,
@@ -507,6 +811,20 @@ class IncrementalFlowGraphBuilder:
                 and len(cluster.machines) == len(cols.machine_names)
                 and [t.uid for t in pending] == cols.uids.tolist()
             )
+            if ok and self.preemption:
+                # the running block is equally load-bearing in
+                # rebalancing mode: verify (uid, machine) pairs against
+                # the live cluster in canonical (uid-sorted) order
+                live = sorted(
+                    (t.uid, t.machine) for t in cluster.tasks
+                    if t.phase == TaskPhase.RUNNING
+                    and t.machine in cols.midx
+                )
+                names = np.array(cols.machine_names, dtype=object)
+                ok = len(live) == len(cols.run_uids) and live == list(
+                    zip(cols.run_uids.tolist(),
+                        names[cols.run_machine].tolist())
+                )
             if not ok:
                 log.warning(
                     "incremental graph state diverged from the cluster "
@@ -518,18 +836,26 @@ class IncrementalFlowGraphBuilder:
             self._uid_pos = {
                 u: i for i, u in enumerate(self._cols.uids.tolist())
             }
+            self._run_pos = {
+                u: i for i, u in enumerate(self._cols.run_uids.tolist())
+            }
             self._rebuild = None
             self._added.clear()
             self._removed.clear()
             self._updated.clear()
             self._aged.clear()
             self._slot_delta.clear()
+            self._run_added.clear()
+            self._run_removed.clear()
+            self._run_moved.clear()
+            self._run_updated.clear()
             self.last_build_mode = "full"
             self.builds_full += 1
         else:
             self.last_build_mode = "delta"
             self.builds_delta += 1
-        return self.builder.assemble(self._cols)
+        self._merged = self.builder.merge_columns(self._cols)
+        return self.builder.assemble(self._merged)
 
     # ---- the O(K) patch ----------------------------------------------
 
@@ -537,7 +863,9 @@ class IncrementalFlowGraphBuilder:
         cols = self._cols
         assert cols is not None
         if not (self._added or self._removed or self._updated
-                or self._aged or self._slot_delta):
+                or self._aged or self._slot_delta or self._run_added
+                or self._run_removed or self._run_moved
+                or self._run_updated):
             return
         uids = cols.uids
         jobs = cols.jobs
@@ -605,7 +933,7 @@ class IncrementalFlowGraphBuilder:
                     job_idx = inv[job_idx]
                     jobs = jobs[perm]
                     job_counts = job_counts[perm]
-    
+
         if self._added:
             midx = cols.midx
             rack_idx = {r: i for i, r in enumerate(cols.racks)}
@@ -665,11 +993,128 @@ class IncrementalFlowGraphBuilder:
             if (used_slots < 0).any():
                 raise _DeltaUnsupported("negative running-slot count")
 
+        # ---- running block (rebalancing mode) -------------------------
+        run_uids = cols.run_uids
+        run_job = cols.run_job
+        run_machine = cols.run_machine
+        run_wait = cols.run_wait
+        run_cpu = cols.run_cpu
+        run_mem = cols.run_mem
+        run_pc = cols.run_pref_counts
+        run_pm, run_pr, run_pw = (
+            cols.run_pref_m, cols.run_pref_r, cols.run_pref_w
+        )
+
+        if self._run_moved:
+            run_machine = run_machine.copy()
+            for uid, name in self._run_moved.items():
+                i = cols.midx.get(name)
+                if i is None:
+                    raise _DeltaUnsupported("move to unknown machine")
+                run_machine[self._run_pos[uid]] = i
+
+        if self._run_updated:
+            run_cpu = run_cpu.copy()
+            run_mem = run_mem.copy()
+            for uid, t in self._run_updated.items():
+                p = self._run_pos[uid]
+                run_cpu[p] = int(t.cpu_request * 1000)
+                run_mem[p] = t.memory_request_kb
+
+        if self._run_removed:
+            pos = np.fromiter(
+                (self._run_pos[u] for u in self._run_removed),
+                np.int64, len(self._run_removed),
+            )
+            keep = np.ones(len(run_uids), bool)
+            keep[pos] = False
+            pkeep = np.repeat(keep, run_pc)
+            run_uids = run_uids[keep]
+            run_job = run_job[keep]
+            run_machine = run_machine[keep]
+            run_wait = run_wait[keep]
+            run_cpu = run_cpu[keep]
+            run_mem = run_mem[keep]
+            run_pc = run_pc[keep]
+            run_pm = run_pm[pkeep]
+            run_pr = run_pr[pkeep]
+            run_pw = run_pw[pkeep]
+
+        if self._run_added:
+            midx = cols.midx
+            rack_idx = {r: i for i, r in enumerate(cols.racks)}
+            add = sorted(self._run_added.values(), key=lambda t: t.uid)
+            if any(t.machine not in midx for t in add):
+                raise _DeltaUnsupported("running add on unknown machine")
+            per = [
+                self.builder._task_prefs(t, midx, rack_idx) for t in add
+            ]
+            trip = [row for rows in per for row in rows]
+            a_pc = np.array([len(rows) for rows in per], np.int64)
+            # merge-sort the sorted additions into the uid-sorted block
+            all_uids = np.concatenate([
+                run_uids, np.array([t.uid for t in add], dtype=object),
+            ])
+            order = np.argsort(all_uids, kind="stable")
+            counts_all = np.concatenate([run_pc, a_pc])
+            new_counts = counts_all[order]
+            tot = int(counts_all.sum())
+            pm_all = np.concatenate(
+                [run_pm, np.array([x[0] for x in trip], np.int32)]
+            )
+            pr_all = np.concatenate(
+                [run_pr, np.array([x[1] for x in trip], np.int32)]
+            )
+            pw_all = np.concatenate(
+                [run_pw, np.array([x[2] for x in trip], np.int32)]
+            )
+            if tot:
+                starts = np.zeros(len(counts_all), np.int64)
+                starts[1:] = np.cumsum(counts_all)[:-1]
+                new_starts = np.zeros(len(new_counts), np.int64)
+                new_starts[1:] = np.cumsum(new_counts)[:-1]
+                gather = np.repeat(
+                    starts[order] - new_starts, new_counts
+                ) + np.arange(tot)
+                pm_all = pm_all[gather]
+                pr_all = pr_all[gather]
+                pw_all = pw_all[gather]
+            run_uids = all_uids[order]
+            run_job = np.concatenate([
+                run_job, np.array([t.job_id for t in add], dtype=object),
+            ])[order]
+            run_machine = np.concatenate([
+                run_machine,
+                np.array([midx[t.machine] for t in add], np.int32),
+            ])[order]
+            run_wait = np.concatenate([
+                run_wait,
+                np.array([t.wait_rounds for t in add], np.int32),
+            ])[order]
+            run_cpu = np.concatenate([
+                run_cpu,
+                np.array(
+                    [int(t.cpu_request * 1000) for t in add], np.int64
+                ),
+            ])[order]
+            run_mem = np.concatenate([
+                run_mem,
+                np.array(
+                    [t.memory_request_kb for t in add], np.int64
+                ),
+            ])[order]
+            run_pc = new_counts
+            run_pm, run_pr, run_pw = pm_all, pr_all, pw_all
+
         self._cols = dataclasses.replace(
             cols, uids=uids, jobs=jobs, job_idx=job_idx,
             job_counts=job_counts, wait=wait, pref_counts=pref_counts,
             pref_m=pref_m, pref_r=pref_r, pref_w=pref_w,
             cpu_milli=cpu, mem_kb=mem, used_slots=used_slots,
+            run_uids=run_uids, run_job=run_job, run_machine=run_machine,
+            run_wait=run_wait, run_cpu=run_cpu, run_mem=run_mem,
+            run_pref_counts=run_pc, run_pref_m=run_pm,
+            run_pref_r=run_pr, run_pref_w=run_pw,
         )
         if self._removed:
             self._uid_pos = {
@@ -679,8 +1124,16 @@ class IncrementalFlowGraphBuilder:
             base = len(self._uid_pos)
             for k, uid in enumerate(self._added):
                 self._uid_pos[uid] = base + k
+        if self._run_removed or self._run_added:
+            self._run_pos = {
+                u: i for i, u in enumerate(run_uids.tolist())
+            }
         self._added.clear()
         self._removed.clear()
         self._updated.clear()
         self._aged.clear()
         self._slot_delta.clear()
+        self._run_added.clear()
+        self._run_removed.clear()
+        self._run_moved.clear()
+        self._run_updated.clear()

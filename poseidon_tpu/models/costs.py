@@ -44,12 +44,22 @@ _SCALE = 10
 #   cases quincy 5*_SCALE*(WAIT_CAP+1) = 3.05k, coco COST_CAP//4 +
 #   5*_SCALE*WAIT_CAP = 5.5k;
 # - quincy's task_input (summed locality weights, data-dependent) ->
-#   clamped so TASK_TO_CLUSTER = total + _SCALE stays at 6k.
+#   clamped so TASK_TO_CLUSTER = total + _SCALE stays at 6k;
+# - the rebalancing preemption overlay (a RUNNING task's unsched arc =
+#   model unsched price + PREEMPTION_PENALTY) -> min-clamped at
+#   DOMAIN_SAFE_COST inside ``_finish``.
 # Genuinely pathological data (e.g. octopus with >600 running tasks on
 # one machine) can still exceed the ceiling; those rounds fall back to
 # the oracle loudly, which is the intended envelope behavior.
 DOMAIN_SAFE_COST = 6_000
 WAIT_CAP = 60
+
+# Rebalancing overlay: what preempting (parking) a RUNNING task adds on
+# top of the model's unscheduled price. High enough that preemption only
+# wins when the packing is badly wrong (or capacity shrank), low enough
+# to stay inside the flagship cost domain after the DOMAIN_SAFE_COST
+# clamp in ``_finish``.
+PREEMPTION_PENALTY = 100 * _SCALE
 
 
 @jax.tree_util.register_dataclass
@@ -67,8 +77,12 @@ class CostInputs:
     task: jax.Array          # int32[E] gather-safe task index
     machine: jax.Array       # int32[E] gather-safe machine index
     weight: jax.Array        # int32[E] data-locality weight
+    discount: jax.Array      # int32[E] hysteresis discount
+                             # (rebalancing continuation arcs; else 0)
     valid: jax.Array         # bool[E]  real (non-padding) arcs
     task_wait: jax.Array     # int32[Tp] rounds waited per task
+    task_running: jax.Array  # bool[Tp] RUNNING (rebalancing) tasks —
+                             # their unsched arc is the preemption price
     task_input: jax.Array    # int32[Tp] total input data units per task
     task_cpu: jax.Array      # int32[Tp] requested milli-cores
     task_mem_kb: jax.Array   # int32[Tp] requested memory
@@ -141,8 +155,10 @@ def build_cost_inputs_host(
         task=pad_arc(np.maximum(meta.arc_task, 0), 0),
         machine=pad_arc(np.maximum(meta.arc_machine, 0), 0),
         weight=pad_arc(meta.arc_weight, 0),
+        discount=pad_arc(meta.arc_discount, 0),
         valid=np.arange(E) < meta.n_arcs,
         task_wait=padv(meta.task_wait, Tp, np.int32),
+        task_running=padv(meta.task_current >= 0, Tp, bool),
         task_input=tin.astype(np.int32),
         task_cpu=padv(task_cpu_milli, Tp, np.int32),
         task_mem_kb=padv(task_mem_kb, Tp, np.int32),
@@ -157,8 +173,30 @@ def build_cost_inputs_host(
 
 
 def _finish(inputs: CostInputs, cost: jax.Array) -> jax.Array:
-    """Clamp to the documented domain and zero the padding slots."""
+    """Clamp to the documented domain and zero the padding slots.
+
+    Also applies the rebalancing overlays shared by every model — the
+    identity when the graph carries no running tasks / discounts, so
+    place-only pricing is unchanged:
+
+    - a RUNNING task's unscheduled arc is its preemption price (model
+      unsched price + PREEMPTION_PENALTY, clamped at DOMAIN_SAFE_COST
+      so the flagship dense domain holds);
+    - continuation arcs subtract their hysteresis discount, so staying
+      put beats migrating unless the solver finds at least that much
+      improvement elsewhere.
+    """
     cost = jnp.clip(cost, 0, COST_CAP).astype(jnp.int32)
+    running = inputs.task_running[inputs.task]
+    preempt = running & (
+        inputs.kind == jnp.int32(int(ArcKind.TASK_TO_UNSCHED))
+    )
+    cost = jnp.where(
+        preempt,
+        jnp.minimum(cost + PREEMPTION_PENALTY, DOMAIN_SAFE_COST),
+        cost,
+    )
+    cost = jnp.maximum(cost - inputs.discount, 0)
     return jnp.where(inputs.valid, cost, 0)
 
 

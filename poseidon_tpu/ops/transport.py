@@ -432,6 +432,44 @@ def extract_instance(net: FlowNetwork, meta: GraphMeta) -> TransportInstance:
     return instance_from_topology(topo, host["cost"])
 
 
+def assignment_cost(
+    inst: TransportInstance, assignment: np.ndarray
+) -> int:
+    """Objective of a FIXED assignment over a transport instance.
+
+    The status-quo evaluator for rebalancing: price the current
+    placement (every running task stays, pending tasks stay parked)
+    under the same instance the solver optimizes, so "how much does
+    rebalancing save" is one subtraction. Each assigned task routes
+    through its cheapest channel to its fixed machine; unassigned tasks
+    pay their unsched route. Raises ValueError if some assigned machine
+    is unreachable for its task (no channel covers it).
+    """
+    asg = np.asarray(assignment, np.int64)
+    T = inst.n_tasks
+    if T == 0:
+        return 0
+    on = asg >= 0
+    m = np.clip(asg, 0, max(inst.n_machines - 1, 0))
+    best = np.where(on, inst.w + inst.d[m], INF)  # cluster channel
+    hit_m = inst.pref_machine == asg[:, None]
+    pc = np.where(hit_m, inst.pref_cost, INF)
+    hit_r = (inst.pref_rack >= 0) & (
+        inst.pref_rack == inst.rack_of[m][:, None]
+    )
+    pc = np.minimum(
+        pc, np.where(hit_r, inst.pref_cost + inst.ra[m][:, None], INF)
+    )
+    best = np.minimum(best, pc.min(axis=1, initial=INF))
+    if (best[on] >= INF).any():
+        bad = int(np.flatnonzero(on & (best >= INF))[0])
+        raise ValueError(
+            f"task {bad} cannot reach its assigned machine "
+            f"{int(asg[bad])} through any channel"
+        )
+    return int(np.where(on, best, inst.u).sum())
+
+
 @dataclasses.dataclass(frozen=True)
 class TransportResult:
     assignment: np.ndarray   # int32[T] machine index, -1 = unscheduled

@@ -37,8 +37,6 @@ from poseidon_tpu.ops.dense_auction import (
     CostDomainTooLarge,
     DenseMemoryTooLarge,
     DenseState,
-    build_dense_instance,
-    solve_dense,
     solve_transport_dense,
 )
 from poseidon_tpu.ops.transport import (
@@ -88,6 +86,34 @@ class SolveOutcome:
     # entirely (the general path-peeling costs ~130 ms at the flagship
     # scale; the auction already knows every task's machine)
     assignment: np.ndarray | None = None
+
+
+def assignment_from_outcome(
+    outcome: SolveOutcome, meta: GraphMeta, net: FlowNetwork
+) -> np.ndarray:
+    """Per-task machine indices (-1 = unscheduled) for any outcome.
+
+    This is the delta extractor's input (``graph.deltas
+    .extract_deltas``): backends that assign directly (the dense
+    auction) return it as-is; flow-only backends (oracle fallbacks, the
+    general lane) decompose their flows into placements first.
+    """
+    if outcome.assignment is not None:
+        return np.asarray(outcome.assignment, np.int32)
+    from poseidon_tpu.graph.decompose import extract_placements
+
+    host = net.to_host()
+    placements = extract_placements(
+        np.asarray(outcome.flows, np.int64), meta,
+        host["src"], host["dst"],
+    )
+    midx = {name: i for i, name in enumerate(meta.machine_names)}
+    asg = np.full(len(meta.task_uids), -1, np.int32)
+    for i, uid in enumerate(meta.task_uids):
+        m = placements.get(uid)
+        if m is not None:
+            asg[i] = midx[m]
+    return asg
 
 
 # Topology cache: repeated solves over the SAME GraphMeta object (what-

@@ -116,6 +116,55 @@ def config5_whatif(seed: int = 0) -> ClusterState:
     return make_synthetic_cluster(1000, 4000, seed=seed, prefs_per_task=2)
 
 
+def config6_rebalance(
+    n_machines: int = 48,
+    n_running: int = 120,
+    *,
+    seed: int = 0,
+) -> ClusterState:
+    """Config 6: a drifted cluster for the rebalancing bench.
+
+    Every task is already RUNNING, crowded onto the first quarter of
+    the machines (the packing a restart-adoption or a long
+    arrival-burst leaves behind), while each task's input data lives on
+    a machine drawn across the whole cluster. A place-only scheduler is
+    stuck with this packing forever; the rebalancing subsystem
+    (``--enable_preemption``) migrates tasks toward their data under
+    the churn budget until the cluster quiesces.
+    """
+    rng = np.random.default_rng(seed)
+    crowd = max(n_machines // 4, 1)
+    slots = -(-n_running // crowd) + 2  # crowded fit + headroom
+    machines = [
+        Machine(
+            name=f"m{i:03d}",
+            rack=f"rack{i % 4}",
+            cpu_capacity=16.0,
+            cpu_allocatable=16.0,
+            memory_capacity_kb=1 << 24,
+            memory_allocatable_kb=1 << 24,
+            max_tasks=slots,
+        )
+        for i in range(n_machines)
+    ]
+    tasks = [
+        Task(
+            uid=f"run-{j:04d}",
+            job=f"job-{j // 6}",
+            cpu_request=0.25,
+            memory_request_kb=1 << 12,
+            phase=TaskPhase.RUNNING,
+            machine=f"m{j % crowd:03d}",
+            data_prefs={
+                f"m{int(rng.integers(0, n_machines)):03d}":
+                    int(rng.integers(100, 300))
+            },
+        )
+        for j in range(n_running)
+    ]
+    return ClusterState(machines=machines, tasks=tasks)
+
+
 def config4_trace_replay(
     n_machines: int = 12_000,
     *,

@@ -7,7 +7,9 @@ scheduler so Firmament can emit Google-cluster-trace-style event logs
 scheduler event, with an injectable clock so tests are deterministic.
 
 Event types mirror the cluster-trace vocabulary: SUBMIT (pod observed),
-SCHEDULE (placement decision), EVICT (node loss), FINISH (pod retired),
+SCHEDULE (placement decision), MIGRATE (rebalancing move, ``detail.
+from`` names the old machine), PREEMPT (rebalancing park), EVICT (node
+loss), FINISH (pod retired),
 plus ROUND records carrying the per-phase timing/stat payload
 (``SchedulerStats`` as a dict — including the round-pipeline timers:
 ``build_mode`` delta/full/legacy, ``dispatch_ms``, ``fetch_wait_ms``,
@@ -16,8 +18,8 @@ excluding the overlap window where the loop worked on other rounds).
 
 Pipelined rounds (bridge ``begin_round``/``finish_round``) emit their
 ROUND record at finish time, so a round's SCHEDULE/ROUND events may
-interleave with the NEXT round's SUBMIT events in the stream; consumers
-must order by ``round_num``, not file position.
+interleave with the NEXT round's SUBMIT events in the stream;
+``read_trace`` does the ``round_num`` ordering for consumers.
 """
 
 from __future__ import annotations
@@ -32,7 +34,8 @@ from typing import Callable, IO
 @dataclasses.dataclass
 class TraceEvent:
     timestamp_us: int
-    event: str              # SUBMIT | SCHEDULE | EVICT | FINISH | ROUND
+    event: str              # SUBMIT | SCHEDULE | MIGRATE | PREEMPT |
+                            # EVICT | FINISH | ROUND
     task: str = ""
     machine: str = ""
     round_num: int = 0
@@ -81,3 +84,23 @@ class TraceGenerator:
     def flush(self) -> None:
         if self.sink is not None:
             self.sink.flush()
+
+
+def read_trace(path: str):
+    """Yield a trace file's events ordered by ``round_num``.
+
+    Pipelined rounds interleave a round's SCHEDULE/ROUND records with
+    the next round's SUBMIT records in file order; this reader restores
+    round order (stable within a round, so per-round event order is
+    file order) so consumers do not have to re-implement the sort the
+    module docstring used to prescribe. Blank lines are skipped; a
+    malformed line raises ``json.JSONDecodeError`` like any other
+    corrupt input.
+    """
+    with open(path) as fh:
+        events = [
+            TraceEvent(**json.loads(line))
+            for line in fh if line.strip()
+        ]
+    events.sort(key=lambda e: e.round_num)  # stable: file order within
+    yield from events

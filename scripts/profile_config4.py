@@ -29,7 +29,6 @@ def main() -> int:
     from poseidon_tpu.cluster import TaskPhase
     from poseidon_tpu.graph.builder import FlowGraphBuilder
     from poseidon_tpu.models.costs import build_cost_inputs_host
-    from poseidon_tpu.ops import resident as rz
     from poseidon_tpu.ops.resident import (
         ResidentSolver,
         _finalize,

@@ -331,7 +331,6 @@ class TestSolveGeneralErrorChain:
     def test_general_guard_runtimeerror_chains_cause(self):
         from poseidon_tpu.solver import solve_scheduling
         from poseidon_tpu.graph.builder import FlowGraphBuilder
-        import dataclasses as dc
 
         # a non-taxonomy graph whose capacities trip the general
         # backend's excess-wrap precheck (int32 accumulator guard)

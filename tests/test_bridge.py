@@ -2,8 +2,6 @@
 
 import dataclasses
 
-import numpy as np
-
 from poseidon_tpu.bridge import SchedulerBridge
 from poseidon_tpu.cluster import Machine, Task, TaskPhase
 
@@ -100,10 +98,6 @@ class TestLifecycle:
             bridge.confirm_binding(uid, m)
         # node m0 disappears
         bridge.observe_nodes(_machines(2)[1:])
-        evicted = [
-            uid for uid, t in bridge.tasks.items()
-            if t.phase == TaskPhase.PENDING
-        ]
         r2 = bridge.run_scheduler()
         assert r2.stats.evictions >= 0
         # every task ends up pending-or-placed on the surviving node
@@ -287,3 +281,34 @@ class TestPipelinedEquivalence:
         assert res.stats.pods_unscheduled == 3
         for uid in ("p0", "p1", "p2"):
             assert bridge.tasks[uid].wait_rounds == 1
+
+
+class TestBindFailureAccounting:
+    """Failed binding POSTs are counted in SchedulerStats and the pod
+    is re-queued as unscheduled (aging preserved), not silently
+    believed placed (the reference just logs, k8s_api_client.cc)."""
+
+    def test_serial_failure_requeues_with_aging(self):
+        bridge = SchedulerBridge(cost_model="trivial")
+        bridge.observe_nodes(_machines(2))
+        bridge.observe_pods(_pods(2))
+        r1 = bridge.run_scheduler()
+        uid, other = sorted(r1.bindings)
+        # serial contract: the POST failed before any confirm
+        bridge.binding_failed(uid)
+        assert bridge.tasks[uid].phase == TaskPhase.PENDING
+        assert bridge.tasks[uid].wait_rounds == 1
+        # optimistic contract: confirmed Running first, then failed
+        bridge.confirm_binding(other, r1.bindings[other])
+        bridge.binding_failed(other)
+        assert bridge.tasks[other].phase == TaskPhase.PENDING
+        r2 = bridge.run_scheduler()
+        assert r2.stats.bind_failures == 2
+        # both pods were re-offered and land again
+        assert set(r2.bindings) == {uid, other}
+        # the counter is per-round: it resets after being reported
+        bridge.observe_pods(
+            [dataclasses.replace(t) for t in bridge.tasks.values()]
+        )
+        r3 = bridge.run_scheduler()
+        assert r3.stats.bind_failures == 0

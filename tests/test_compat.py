@@ -1,0 +1,44 @@
+"""compat.py: the jax 0.4.x shims every call site imports from."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poseidon_tpu import compat
+
+
+class TestEnableX64:
+    def test_context_manager_toggles_x64(self):
+        with compat.enable_x64(True):
+            assert jnp.asarray(np.int64(2**40)).dtype == jnp.int64
+        # outside the context the default (x32) rules apply again
+        assert jnp.asarray(np.int64(2**40)).dtype == jnp.int32
+
+    def test_nests(self):
+        with compat.enable_x64(True):
+            with compat.enable_x64(True):
+                assert jnp.asarray(1.0, jnp.float64).dtype == jnp.float64
+            assert jnp.asarray(np.int64(5)).dtype == jnp.int64
+
+
+class TestShardMap:
+    def test_shard_map_runs_on_the_test_mesh(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        devs = np.array(jax.devices())
+        mesh = Mesh(devs, ("d",))
+        n = len(devs)
+
+        def f(x):
+            return x * 2
+
+        y = compat.shard_map(
+            f, mesh=mesh, in_specs=P("d"), out_specs=P("d")
+        )(jnp.arange(4 * n, dtype=jnp.int32))
+        assert np.array_equal(np.asarray(y), np.arange(4 * n) * 2)
+
+
+class TestSurface:
+    def test_all_exports_resolve(self):
+        for name in compat.__all__:
+            assert getattr(compat, name, None) is not None

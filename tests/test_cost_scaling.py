@@ -2,7 +2,6 @@
 
 from poseidon_tpu.compat import enable_x64
 import numpy as np
-import pytest
 
 from poseidon_tpu.graph.network import FlowNetwork
 from poseidon_tpu.ops.cost_scaling import solve_cost_scaling, solution_cost
@@ -108,7 +107,6 @@ class TestWhatIfBatching:
         of one topology, all solved in a single device program."""
         import jax
         import jax.numpy as jnp
-        import dataclasses
         from poseidon_tpu.ops.cost_scaling import _solve
 
         rng = np.random.default_rng(55)

@@ -210,7 +210,6 @@ class TestHistDebugPath:
         # (two scatters/round, ~40% of a cold solve when left on);
         # keep the debug variant compiling and self-consistent
         import jax
-        import jax.numpy as jnp
 
         from poseidon_tpu.ops.dense_auction import (
             _solve,

@@ -9,7 +9,7 @@ Exercises every layer; runs on the CPU test platform.
 import numpy as np
 
 from poseidon_tpu.apiclient import FakeApiServer, K8sApiClient
-from poseidon_tpu.apiclient.client import ApiError, parse_cpu, parse_memory_kb
+from poseidon_tpu.apiclient.client import parse_cpu, parse_memory_kb
 from poseidon_tpu.bridge import SchedulerBridge
 from poseidon_tpu.cli import parse_args, run_loop
 from poseidon_tpu.graph.builder import FlowGraphBuilder

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from poseidon_tpu.cluster import ClusterState, Machine, Task, TaskPhase
+from poseidon_tpu.cluster import ClusterState, Machine, Task
 from poseidon_tpu.graph.builder import ArcKind, FlowGraphBuilder
 from poseidon_tpu.models import (
     COST_CAP,

@@ -63,7 +63,6 @@ class TestShardedSolve:
         o = solve_oracle(net, algorithm="cost_scaling")
         assert bool(jax.device_get(state.converged))
         # decode the SHARDED state's own assignment and cost it
-        Mp = dev.c.shape[1]
         asg = np.asarray(jax.device_get(state.asg))[: inst.n_tasks]
         asg = np.where((asg >= 0) & (asg < inst.n_machines), asg, -1)
         ch = _channels_for(inst, asg.astype(np.int32))

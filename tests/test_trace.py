@@ -1,0 +1,70 @@
+"""Trace stream: event emission + the read_trace round-order reader."""
+
+import json
+
+from poseidon_tpu.trace import TraceEvent, TraceGenerator, read_trace
+
+
+class TestReadTrace:
+    def test_orders_by_round_stable_within_round(self, tmp_path):
+        """Pipelined rounds interleave round N's SCHEDULE/ROUND with
+        round N+1's SUBMITs in file order; read_trace restores round
+        order while keeping file order within a round."""
+        path = tmp_path / "trace.jsonl"
+        clock = iter(range(100))
+        with open(path, "w") as fh:
+            gen = TraceGenerator(sink=fh, clock_us=lambda: next(clock))
+            gen.emit("SUBMIT", task="p0", round_num=1)
+            gen.emit("SUBMIT", task="p1", round_num=2)  # interleaved
+            gen.emit("SCHEDULE", task="p0", machine="m0", round_num=1)
+            gen.emit("ROUND", round_num=1, detail={"cost": 3})
+            gen.emit("MIGRATE", task="q0", machine="m1", round_num=2,
+                     detail={"from": "m0"})
+            gen.emit("ROUND", round_num=2)
+            gen.flush()
+
+        events = list(read_trace(str(path)))
+        assert [e.round_num for e in events] == [1, 1, 1, 2, 2, 2]
+        assert [e.event for e in events] == [
+            "SUBMIT", "SCHEDULE", "ROUND", "SUBMIT", "MIGRATE", "ROUND",
+        ]
+        assert isinstance(events[0], TraceEvent)
+        assert events[4].detail == {"from": "m0"}
+        # stability: round 1's events kept their file order
+        assert events[1].task == "p0" and events[1].machine == "m0"
+
+    def test_skips_blank_lines(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        ev = {"timestamp_us": 1, "event": "SUBMIT", "task": "p",
+              "machine": "", "round_num": 3, "detail": None}
+        path.write_text(json.dumps(ev) + "\n\n" + json.dumps(ev) + "\n")
+        assert len(list(read_trace(str(path)))) == 2
+
+    def test_bridge_emits_migrate_and_preempt_events(self):
+        """The rebalancing round's decisions land in the trace
+        stream with their machines."""
+        from poseidon_tpu.bridge import SchedulerBridge
+        from poseidon_tpu.cluster import Machine, Task, TaskPhase
+
+        bridge = SchedulerBridge(
+            cost_model="quincy", enable_preemption=True,
+            migration_hysteresis=20,
+        )
+        bridge.observe_nodes([
+            Machine(name="m0", max_tasks=2), Machine(name="m1", max_tasks=2),
+        ])
+        bridge.observe_pods([
+            Task(uid="q0", phase=TaskPhase.RUNNING, machine="m0",
+                 data_prefs={"m1": 200}),
+            Task(uid="q1", phase=TaskPhase.RUNNING, machine="m0"),
+            Task(uid="q2", phase=TaskPhase.RUNNING, machine="m0"),
+        ])
+        r = bridge.run_scheduler()
+        assert r.stats.deltas_migrate + r.stats.deltas_preempt >= 1
+        kinds = {e.event for e in bridge.trace.events}
+        assert "MIGRATE" in kinds or "PREEMPT" in kinds
+        for e in bridge.trace.events:
+            if e.event == "MIGRATE":
+                assert e.detail["from"] and e.machine
+            if e.event == "PREEMPT":
+                assert e.machine

@@ -808,6 +808,12 @@ def bench_trace_replay(
     row["pipelined_round_wall_p50_ms"] = _ms(
         [t / 1000 for t in pip_iter[2:]]
     )
+    # the observe phase (snapshot diff host work), now a first-class
+    # per-phase timer like build/price/solve/decompose
+    row["observe_p50_ms"] = _ms([s.observe_ms / 1000 for s in steady])
+    row["pipelined_observe_p50_ms"] = _ms(
+        [s.observe_ms / 1000 for s in psteady]
+    )
     if row["pipelined_total_p50_ms"] > 0:
         row["pipeline_total_speedup"] = round(
             row["serial_total_p50_ms"]
@@ -962,16 +968,174 @@ def bench_rebalance(
         "budget_respected": all(d <= budget for d in disruptive),
         "pipelined_deltas_equal": pipelined_equal,
         "backends": sorted({s.stats.backend for s in res_s}),
+        "observe_p50_ms": _ms(
+            [s.stats.observe_ms / 1000 for s in res_s]
+        ),
     }
+
+
+def bench_observe_watch(
+    *, n_nodes: int = 120, n_pods: int = 1500, scale: int = 2,
+    rounds: int = 10, churn: int = 15,
+) -> dict:
+    """Config 7: observe-phase p50, poll vs watch, at ~1% churn.
+
+    Drives the same scripted churn (``churn`` pod adds + ``churn//2``
+    deletes per round) against two identical fake apiservers — one
+    bridge observing via full-list polls, one via the watch subsystem —
+    and times ONLY the observe phase (list+parse+diff vs event
+    drain+decode+apply). Repeats at ``scale``x the cluster size with
+    the SAME absolute churn: poll observe grows with the cluster, watch
+    observe stays flat (it scales with churn), which is the whole point
+    of the subsystem. Cross-checks that both bridges hold identical
+    task/machine state at the end, and surfaces the per-round
+    ``SchedulerStats.observe_ms`` timer from one real scheduling round.
+    """
+    import collections as _collections
+
+    from poseidon_tpu.apiclient import (
+        ClusterWatcher,
+        FakeApiServer,
+        K8sApiClient,
+    )
+    from poseidon_tpu.bridge import SchedulerBridge
+
+    def populate(server, nn, np_):
+        for i in range(nn):
+            server.add_node(f"n{i:04d}", cpu="16", memory="32Gi",
+                            pods=max(np_ // nn + 4, 8),
+                            rack=f"rack{i % 8}")
+        for j in range(np_):
+            server.add_pod(f"pod-{j:05d}", cpu="100m", memory="64Mi",
+                           job=f"job{j // 16}")
+
+    def run_mode(mode, nn, np_):
+        server = FakeApiServer().start()
+        watcher = None
+        try:
+            populate(server, nn, np_)
+            client = K8sApiClient("127.0.0.1", server.port)
+            bridge = SchedulerBridge(cost_model="trivial")
+            if mode == "watch":
+                watcher = ClusterWatcher(client, max_lag_s=120.0)
+                d = watcher.tick()
+                bridge.observe_nodes(d.nodes)
+                bridge.observe_pods(d.pods)
+            else:
+                bridge.observe_nodes(client.all_nodes())
+                bridge.observe_pods(client.all_pods())
+            bridge._observe_ms = 0.0  # seed excluded from the p50
+            alive = _collections.deque(
+                f"pod-{j:05d}" for j in range(np_)
+            )
+            times = []
+            resyncs = reconnects = 0
+            for r in range(rounds):
+                for i in range(churn):
+                    name = f"new-{r:02d}-{i:02d}"
+                    server.add_pod(name, cpu="100m", memory="64Mi",
+                                   job=f"jn{r}")
+                for _ in range(churn // 2):
+                    server.delete_pod(alive.popleft())
+                alive.extend(
+                    f"new-{r:02d}-{i:02d}" for i in range(churn)
+                )
+                if watcher is not None:
+                    # event arrival is async; the measured phase is
+                    # drain+decode+apply, which is what a driver tick
+                    # pays (arrival already overlapped the solve)
+                    assert watcher.wait_caught_up(
+                        server.current_rv(), 30.0
+                    ), "watch events never arrived"
+                t0 = time.perf_counter()
+                if watcher is not None:
+                    d = watcher.tick()
+                    if d.resynced:
+                        bridge.observe_nodes(d.nodes)
+                        bridge.observe_pods(d.pods)
+                    else:
+                        for typ, m in d.node_events:
+                            bridge.observe_node_event(typ, m)
+                        for typ, t in d.pod_events:
+                            bridge.observe_pod_event(typ, t)
+                    resyncs += d.resyncs
+                    reconnects += d.reconnects
+                else:
+                    bridge.observe_nodes(client.all_nodes())
+                    bridge.observe_pods(client.all_pods())
+                times.append(time.perf_counter() - t0)
+            state = (
+                list(bridge.machines.items()),
+                list(bridge.tasks.items()),
+            )
+            return times, state, bridge, resyncs, reconnects
+        finally:
+            if watcher is not None:
+                watcher.stop()
+            server.stop()
+
+    row: dict = {
+        "config": "observe_poll_vs_watch",
+        "nodes": n_nodes, "pods": n_pods, "rounds": rounds,
+        "churn_per_round": churn,
+        "churn_frac": round(churn / n_pods, 4),
+    }
+    log("bench: config 7 poll observe ...")
+    t_poll, st_poll, _, _, _ = run_mode(
+        "poll", n_nodes, n_pods
+    )
+    log("bench: config 7 watch observe ...")
+    t_watch, st_watch, bridge_watch, rs, rc = run_mode(
+        "watch", n_nodes, n_pods
+    )
+    row["observe_poll_p50_ms"] = _ms(t_poll)
+    row["observe_watch_p50_ms"] = _ms(t_watch)
+    if row["observe_watch_p50_ms"] > 0:
+        row["observe_poll_over_watch"] = round(
+            row["observe_poll_p50_ms"] / row["observe_watch_p50_ms"], 2
+        )
+    row["watch_resyncs"] = rs
+    row["watch_reconnects"] = rc
+    row["watch_state_equals_poll"] = bool(st_poll == st_watch)
+    # one real scheduling round so the observe_ms stats field is
+    # exercised end to end (the accumulated watch-mode observe time)
+    stats = bridge_watch.run_scheduler().stats
+    row["stats_observe_ms"] = stats.observe_ms
+    # ---- the scaling claim: same churn, 2x cluster ----
+    log(f"bench: config 7 {scale}x cluster, same churn ...")
+    t_poll2, _, _, _, _ = run_mode(
+        "poll", n_nodes * scale, n_pods * scale
+    )
+    t_watch2, _, _, _, _ = run_mode(
+        "watch", n_nodes * scale, n_pods * scale
+    )
+    row["observe_poll_p50_ms_2x"] = _ms(t_poll2)
+    row["observe_watch_p50_ms_2x"] = _ms(t_watch2)
+    if row["observe_poll_p50_ms"] > 0 and row["observe_watch_p50_ms"] > 0:
+        row["poll_scale_factor"] = round(
+            row["observe_poll_p50_ms_2x"]
+            / row["observe_poll_p50_ms"], 2
+        )
+        row["watch_scale_factor"] = round(
+            row["observe_watch_p50_ms_2x"]
+            / row["observe_watch_p50_ms"], 2
+        )
+        # watch observe tracks churn, not cluster size: doubling the
+        # cluster must not move it the way it moves the poll
+        row["watch_scales_with_churn"] = bool(
+            row["watch_scale_factor"] < row["poll_scale_factor"]
+        )
+    return row
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--configs",
-        default="1,2,3,4,5,6",
+        default="1,2,3,4,5,6,7",
         help="comma list of BASELINE config numbers to run "
-             "(6 = the rebalancing drift-correction config)",
+             "(6 = the rebalancing drift-correction config, "
+             "7 = observe-phase poll vs watch)",
     )
     ap.add_argument("--solve-reps", type=int, default=20)
     ap.add_argument("--oracle-reps", type=int, default=3)
@@ -1017,6 +1181,20 @@ def main() -> int:
                 log(f"bench: config 4 FAILED:\n{traceback.format_exc()}")
                 rows.append(
                     {"config": "trace_replay_12k", "config_num": 4,
+                     "error": True}
+                )
+            continue
+        if num == 7:
+            log("bench: running config 7 (observe_poll_vs_watch) ...")
+            try:
+                row = bench_observe_watch()
+                row["config_num"] = 7
+                rows.append(row)
+                log(f"bench: config 7 done: {json.dumps(row)}")
+            except Exception:
+                log(f"bench: config 7 FAILED:\n{traceback.format_exc()}")
+                rows.append(
+                    {"config": "observe_poll_vs_watch", "config_num": 7,
                      "error": True}
                 )
             continue

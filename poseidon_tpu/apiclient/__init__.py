@@ -1,6 +1,14 @@
-"""L2b' — Kubernetes API client + fake apiserver test fixture."""
+"""L2b' — Kubernetes API client, watch subsystem + fake apiserver fixture."""
 
 from poseidon_tpu.apiclient.client import K8sApiClient, parse_cpu, parse_memory_kb
 from poseidon_tpu.apiclient.fake_server import FakeApiServer
+from poseidon_tpu.apiclient.watch import ClusterWatcher, ObserveDelta
 
-__all__ = ["K8sApiClient", "FakeApiServer", "parse_cpu", "parse_memory_kb"]
+__all__ = [
+    "K8sApiClient",
+    "FakeApiServer",
+    "ClusterWatcher",
+    "ObserveDelta",
+    "parse_cpu",
+    "parse_memory_kb",
+]

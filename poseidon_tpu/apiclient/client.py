@@ -13,7 +13,11 @@ Mirrors src/apiclient/k8s_api_client.{h,cc}: GET ``nodes`` / ``pods``
   ``default`` (k8s_api_client.cc:222);
 - transport errors raise ``ApiError`` after bounded retries instead of
   dissolving into logged JSON (utils.cc:47-61); the driver loop decides
-  to skip the tick;
+  to skip the tick. Retries use jittered exponential backoff and apply
+  only to failures that CAN heal (429, 5xx, transport/decode errors);
+  a 404/400 fails fast — re-asking the same question three times just
+  delays the inevitable and hammers a struggling apiserver. A 429's
+  ``Retry-After`` header is honored as a lower bound on the delay;
 - list pagination is followed (``metadata.continue`` tokens, chunked via
   ``limit``). The reference does one unpaginated GET and parses whatever
   came back (k8s_api_client.cc:100-160); against an apiserver that
@@ -30,12 +34,15 @@ three orders of magnitude off the solve path.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
+import random
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from typing import Callable
 
 from poseidon_tpu.cluster import Machine, Task, TaskPhase
 
@@ -50,6 +57,25 @@ RACK_LABELS = (
 
 class ApiError(RuntimeError):
     """The apiserver could not be reached or answered garbage."""
+
+
+def backoff_delay(
+    attempt: int,
+    *,
+    base_s: float = 0.05,
+    cap_s: float = 2.0,
+    rng: Callable[[], float] = random.random,
+) -> float:
+    """Jittered exponential backoff: ``min(cap, base·2^attempt)``
+    scaled by a uniform [0.5, 1.5) jitter factor.
+
+    The jitter matters operationally: a fleet of schedulers whose
+    apiserver hiccuped would otherwise all retry on the same metronome
+    and re-create the thundering herd that caused the hiccup. Shared by
+    the request retry loop here and the watch-stream reconnects
+    (apiclient/watch.py).
+    """
+    return min(cap_s, base_s * (2.0 ** attempt)) * (0.5 + rng())
 
 
 def parse_cpu(q: str | int | float) -> float:
@@ -97,11 +123,15 @@ class K8sApiClient:
         timeout_s: float = 10.0,
         retries: int = 2,
         page_limit: int = 500,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
     ):
         self.base = f"http://{host}:{port}/api/{api_version}"
         self.timeout_s = timeout_s
         self.retries = retries
         self.page_limit = page_limit
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
         log.info("k8s api client -> %s", self.base)
 
     # ---- transport -----------------------------------------------------
@@ -115,6 +145,7 @@ class K8sApiClient:
             headers["Content-Type"] = "application/json"
         last: Exception | None = None
         for attempt in range(self.retries + 1):
+            retry_after = ""
             try:
                 req = urllib.request.Request(
                     url, data=data, headers=headers
@@ -124,17 +155,50 @@ class K8sApiClient:
                 ) as resp:
                     payload = resp.read()
                 return json.loads(payload) if payload else {}
-            except (OSError, json.JSONDecodeError) as e:
+            except urllib.error.HTTPError as e:
+                # checked BEFORE the transport clause: HTTPError is an
+                # OSError, and retrying a 404/400 just burns every
+                # attempt on an answer that will not change. Only 429
+                # (throttled) and 5xx (server-side trouble) can heal.
+                if e.code != 429 and e.code < 500:
+                    raise ApiError(f"{url}: HTTP {e.code}") from e
+                if e.code == 429:
+                    retry_after = e.headers.get("Retry-After", "")
+                last = e
+            except (
+                OSError,
+                http.client.HTTPException,
+                json.JSONDecodeError,
+            ) as e:
                 # OSError covers URLError, TimeoutError AND the raw
                 # socket errors (ConnectionResetError) that surface
-                # under concurrent bindings POSTs mid-body-read
+                # under concurrent bindings POSTs mid-body-read;
+                # HTTPException covers IncompleteRead when the server
+                # drops the connection mid-body
                 last = e
-                if attempt < self.retries:
-                    time.sleep(0.05 * (attempt + 1))
+            if attempt < self.retries:
+                delay = backoff_delay(
+                    attempt,
+                    base_s=self.backoff_base_s,
+                    cap_s=self.backoff_cap_s,
+                )
+                if retry_after:
+                    try:
+                        delay = max(delay, float(retry_after))
+                    except ValueError:
+                        pass  # HTTP-date form: keep the jittered delay
+                time.sleep(delay)
         raise ApiError(f"{url}: {last}") from last
 
     def _list(self, resource: str, selector: str = "") -> list[dict]:
+        return self._list_rv(resource, selector)[0]
+
+    def _list_rv(
+        self, resource: str, selector: str = ""
+    ) -> tuple[list[dict], int]:
         """Chunked list: follow ``metadata.continue`` until exhausted.
+        Returns ``(items, resourceVersion)`` — the rv is the watch
+        protocol's starting point (apiclient/watch.py).
 
         All pages of one logical list are fetched before parsing; a page
         failure (after per-request retries) raises so the caller never
@@ -143,6 +207,7 @@ class K8sApiClient:
         """
         items: list[dict] = []
         token = ""
+        rv = 0
         # bounded like every other failure mode in this client: a server
         # that replays the same continue token (or pages forever) must
         # surface as a skipped tick, not a silent daemon hang
@@ -160,9 +225,14 @@ class K8sApiClient:
                 path += "?" + urllib.parse.urlencode(params)
             doc = self._request(path)
             items.extend(doc.get("items", []))
-            next_token = doc.get("metadata", {}).get("continue", "") or ""
+            meta = doc.get("metadata", {})
+            try:
+                rv = int(meta.get("resourceVersion", rv) or rv)
+            except (TypeError, ValueError):
+                pass  # apiservers may use opaque rvs; watch needs ints
+            next_token = meta.get("continue", "") or ""
             if not next_token:
-                return items
+                return items, rv
             if next_token == token:
                 raise ApiError(
                     f"{resource}: apiserver replayed continue token "
@@ -184,6 +254,18 @@ class K8sApiClient:
 
     def all_nodes(self) -> list[Machine]:
         return self.nodes_with_label("")
+
+    def nodes_with_rv(self) -> tuple[list[Machine], int]:
+        """Full node list plus the list's ``resourceVersion`` — the
+        snapshot+rv pair a watch stream continues from."""
+        items, rv = self._list_rv("nodes")
+        out = []
+        for item in items:
+            try:
+                out.append(self._parse_node(item))
+            except (KeyError, ValueError) as e:
+                log.error("skipping unparseable node: %s", e)
+        return out, rv
 
     @staticmethod
     def _parse_node(item: dict) -> Machine:
@@ -222,6 +304,17 @@ class K8sApiClient:
 
     def all_pods(self) -> list[Task]:
         return self.pods_with_label("")
+
+    def pods_with_rv(self) -> tuple[list[Task], int]:
+        """Full pod list plus the list's ``resourceVersion``."""
+        items, rv = self._list_rv("pods")
+        out = []
+        for item in items:
+            try:
+                out.append(self._parse_pod(item))
+            except (KeyError, ValueError) as e:
+                log.error("skipping unparseable pod: %s", e)
+        return out, rv
 
     @staticmethod
     def _parse_pod(item: dict) -> Task:

@@ -7,28 +7,48 @@ comments (k8s_api_client.cc:96-99) — but never builds the fixture
 
 - ``GET /api/v1/nodes``  (optional labelSelector, exact-match subset)
 - ``GET /api/v1/pods``
+- ``GET /api/v1/{nodes,pods}?watch=true&resourceVersion=N`` — the watch
+  protocol: a chunked stream of ``{"type": ADDED|MODIFIED|DELETED|
+  BOOKMARK, "object": ...}`` lines for every mutation with rv > N, in
+  mutation order, each object stamped with its ``metadata.
+  resourceVersion``. Idle streams get a BOOKMARK (current rv, no
+  object) and a clean close, like a real apiserver ending a watch
+  window; clients reconnect from their last rv. A watch from an rv
+  older than the retained event log answers ``410 Gone`` (both shapes
+  the real control plane uses: a plain HTTP 410, and an in-stream
+  ``ERROR`` event with ``code: 410``).
 - ``POST /api/v1/namespaces/{ns}/bindings`` — applies the binding: the
   pod's ``spec.nodeName`` is set and its phase flips to Running on the
-  NEXT poll (bindings are acknowledged before they are observable, like
-  the real control plane).
+  NEXT poll or watch-stream wake (bindings are acknowledged before they
+  are observable, like the real control plane).
 - ``POST /api/v1/namespaces/{ns}/pods/{name}/eviction`` — unbinds the
   pod: ``spec.nodeName`` is cleared and its phase flips back to Pending
   on the NEXT poll. Evictions and bindings are applied in POST order,
   so a MIGRATE (evict + re-bind) lands as one visible move.
 
 Fault injection for resilience tests: ``fail_next(n)`` makes the next n
-requests return HTTP 500; ``drop_node(name)`` removes a node between
-polls (the node-removal path the reference never handled);
-``truncate_lists(n)`` serves only the first n items WITHOUT a continue
-token (a partial snapshot masquerading as complete — the failure mode
-the bridge's mass-eviction guard exists for).
+requests return HTTP 500; ``rate_limit_next(n)`` answers 429 with a
+``Retry-After`` header; ``disconnect_next(n)`` closes the connection
+mid-body (a promised Content-Length never delivered); ``drop_node(name)``
+removes a node between polls (the node-removal path the reference never
+handled); ``truncate_lists(n)`` serves only the first n items WITHOUT a
+continue token (a partial snapshot masquerading as complete — the
+failure mode the bridge's mass-eviction guard exists for). Watch-side:
+``gone_next_watch(n)`` answers the next n watch connects with HTTP 410;
+``disconnect_watch_next(n)`` cuts n active watch streams mid-event-flow
+without a terminating chunk; ``corrupt_next_watch(n)`` emits undecodable
+JSON lines; ``compact_watch_log()`` forgets all history so any resumed
+rv is too old (the natural 410).
 
 List requests honor ``limit``/``continue`` pagination the way the real
-apiserver chunks responses, so the client's token-following is testable.
+apiserver chunks responses, so the client's token-following is testable,
+and every list carries ``metadata.resourceVersion`` so a watch can
+continue exactly where the list snapshot ended.
 """
 
 from __future__ import annotations
 
+import bisect
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -40,6 +60,7 @@ class FakeApiServer:
 
     def __init__(self):
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self.nodes: dict[str, dict] = {}
         self.pods: dict[str, dict] = {}
         self.bindings: list[tuple[str, str]] = []
@@ -47,8 +68,28 @@ class FakeApiServer:
         # bind/evict ops applied in POST order on the next pods poll
         self._pending_ops: list[tuple[str, str, str]] = []
         self._fail_next = 0
+        self._rate_limit_next = 0
+        self._rate_limit_retry_after = 0.05
+        self._disconnect_next = 0
         self._truncate = 0
         self.requests_served = 0
+        # ---- watch protocol state ----
+        # monotonic resourceVersion; every mutation appends one
+        # (rv, kind, type, object-copy) record to the event log
+        self._rv = 0
+        self._events: list[tuple[int, str, str, dict]] = []
+        # rv horizon: a watch may only resume from rv >= this (older
+        # history has been compacted away -> 410 Gone)
+        self._compact_floor = 0
+        self._event_retention = 10_000
+        self._gone_next_watch = 0
+        self._disconnect_watch_next = 0
+        self._corrupt_next_watch = 0
+        self._closing = False
+        # how long an idle watch stream waits for events before sending
+        # a bookmark and closing cleanly (clients reconnect from rv)
+        self.watch_idle_close_s = 0.25
+        self.watch_bookmarks = True
 
         server = self
 
@@ -56,23 +97,83 @@ class FakeApiServer:
             def log_message(self, *a):  # silence
                 pass
 
-            def _reply(self, code: int, doc: dict):
+            def _reply(
+                self, code: int, doc: dict,
+                headers: dict[str, str] | None = None,
+            ):
                 payload = json.dumps(doc).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(payload)
 
-            def do_GET(self):
+            def _drop_mid_body(self):
+                """Promise a body, deliver half of it, cut the
+                connection — the client's read raises IncompleteRead
+                (the mid-body transport error class)."""
+                payload = json.dumps({"items": []}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header(
+                    "Content-Length", str(len(payload) * 2)
+                )
+                self.end_headers()
+                self.wfile.write(payload)
+                self.wfile.flush()
+                self.close_connection = True
+
+            def _injected_fault(self) -> str:
+                """Consume one injected request-level fault, if armed."""
                 with server._lock:
                     server.requests_served += 1
                     if server._fail_next > 0:
                         server._fail_next -= 1
-                        self._reply(500, {"error": "injected"})
-                        return
-                    url = urlparse(self.path)
-                    query = parse_qs(url.query)
+                        return "fail"
+                    if server._rate_limit_next > 0:
+                        server._rate_limit_next -= 1
+                        return "rate"
+                    if server._disconnect_next > 0:
+                        server._disconnect_next -= 1
+                        return "disconnect"
+                return ""
+
+            def _apply_fault(self, fault: str) -> bool:
+                if fault == "fail":
+                    self._reply(500, {"error": "injected"})
+                elif fault == "rate":
+                    self._reply(
+                        429, {"error": "throttled"},
+                        headers={
+                            "Retry-After":
+                                str(server._rate_limit_retry_after)
+                        },
+                    )
+                elif fault == "disconnect":
+                    self._drop_mid_body()
+                else:
+                    return False
+                return True
+
+            def do_GET(self):
+                if self._apply_fault(self._injected_fault()):
+                    return
+                url = urlparse(self.path)
+                query = parse_qs(url.query)
+                if (
+                    query.get("watch", ["false"])[0] == "true"
+                    and url.path in ("/api/v1/nodes", "/api/v1/pods")
+                ):
+                    try:
+                        self._serve_watch(
+                            url.path.rsplit("/", 1)[1], query
+                        )
+                    except (OSError, ValueError):
+                        pass  # client went away mid-stream
+                    return
+                with server._lock:
                     selector = query.get("labelSelector", [""])[0]
                     if url.path == "/api/v1/nodes":
                         items = server._select(
@@ -88,13 +189,109 @@ class FakeApiServer:
                     else:
                         self._reply(404, {"error": self.path})
 
-            def do_POST(self):
+            # ---- the watch stream ----------------------------------
+
+            def _chunk(self, doc: dict) -> None:
+                self._chunk_raw(json.dumps(doc).encode() + b"\n")
+
+            def _chunk_raw(self, data: bytes) -> None:
+                self.wfile.write(
+                    f"{len(data):X}\r\n".encode() + data + b"\r\n"
+                )
+                self.wfile.flush()
+
+            def _serve_watch(self, kind: str, query: dict) -> None:
+                rv = int(
+                    query.get("resourceVersion", ["0"])[0] or 0
+                )
                 with server._lock:
-                    server.requests_served += 1
-                    if server._fail_next > 0:
-                        server._fail_next -= 1
-                        self._reply(500, {"error": "injected"})
+                    if server._gone_next_watch > 0:
+                        server._gone_next_watch -= 1
+                        gone = "http"
+                    elif rv < server._compact_floor:
+                        gone = "stream"
+                    else:
+                        gone = ""
+                if gone == "http":
+                    self._reply(
+                        410,
+                        {"kind": "Status", "code": 410,
+                         "reason": "Expired"},
+                    )
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                if gone == "stream":
+                    # the real apiserver's other 410 shape: an ERROR
+                    # event inside an accepted stream
+                    self._chunk({
+                        "type": "ERROR",
+                        "object": {"kind": "Status", "code": 410,
+                                   "reason": "Expired"},
+                    })
+                    self._chunk_raw(b"")
+                    return
+                while True:
+                    with server._cond:
+                        if server._closing:
+                            break
+                        server._apply_pending()
+                        batch = server._events_after(rv, kind)
+                        if not batch:
+                            server._cond.wait(
+                                server.watch_idle_close_s
+                            )
+                            if server._closing:
+                                break
+                            server._apply_pending()
+                            batch = server._events_after(rv, kind)
+                        cur_rv = server._rv
+                        disconnect = corrupt = False
+                        if batch:
+                            if server._disconnect_watch_next > 0:
+                                server._disconnect_watch_next -= 1
+                                disconnect = True
+                            elif server._corrupt_next_watch > 0:
+                                server._corrupt_next_watch -= 1
+                                corrupt = True
+                    if disconnect:
+                        # mid-stream cut: one event goes out, then the
+                        # connection dies without a terminating chunk
+                        self._chunk({
+                            "type": batch[0][2], "object": batch[0][3],
+                        })
+                        self.connection.close()
                         return
+                    if corrupt:
+                        self._chunk_raw(b'{"type": "ADDED", "obj\n')
+                        self._chunk_raw(b"")
+                        return
+                    if batch:
+                        for rv_i, _k, typ, obj in batch:
+                            self._chunk({"type": typ, "object": obj})
+                            rv = rv_i
+                        continue
+                    # idle window elapsed: bookmark + clean close
+                    if server.watch_bookmarks:
+                        self._chunk({
+                            "type": "BOOKMARK",
+                            "object": {
+                                "kind": kind,
+                                "metadata": {
+                                    "resourceVersion": str(cur_rv)
+                                },
+                            },
+                        })
+                    break
+                self._chunk_raw(b"")  # terminating chunk
+
+            def do_POST(self):
+                fault = self._injected_fault()
+                if self._apply_fault(fault):
+                    return
+                with server._lock:
                     url = urlparse(self.path)
                     parts = url.path.strip("/").split("/")
                     # api/v1/namespaces/{ns}/bindings
@@ -119,6 +316,10 @@ class FakeApiServer:
                             return
                         server._pending_ops.append(("bind", key, node))
                         server.bindings.append((key, node))
+                        # wake parked watch streams so the binding
+                        # becomes observable at their next wake, like
+                        # the next poll would make it
+                        server._cond.notify_all()
                         self._reply(201, {"status": "Bound"})
                     # api/v1/namespaces/{ns}/pods/{name}/eviction
                     elif (
@@ -133,6 +334,7 @@ class FakeApiServer:
                             return
                         server._pending_ops.append(("evict", key, ""))
                         server.evictions.append(key)
+                        server._cond.notify_all()
                         self._reply(201, {"status": "Evicted"})
                     else:
                         self._reply(404, {"error": self.path})
@@ -150,6 +352,9 @@ class FakeApiServer:
         return self
 
     def stop(self) -> None:
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -160,6 +365,41 @@ class FakeApiServer:
         self.stop()
 
     # ---- state helpers -------------------------------------------------
+
+    def _emit(self, kind: str, typ: str, obj: dict) -> None:
+        """Append one watch event (lock held). The object is deep-copied
+        and stamped with its rv, since the live dicts mutate in place."""
+        self._rv += 1
+        copy = json.loads(json.dumps(obj))
+        copy.setdefault("metadata", {})["resourceVersion"] = str(
+            self._rv
+        )
+        self._events.append((self._rv, kind, typ, copy))
+        if len(self._events) > self._event_retention:
+            # trim in one slice (amortized O(1) per event, not a
+            # pop(0) shuffle of the whole retained log each time)
+            cut = len(self._events) - self._event_retention
+            self._compact_floor = self._events[cut - 1][0]
+            del self._events[:cut]
+        self._cond.notify_all()
+
+    def _events_after(self, rv: int, kind: str) -> list[tuple]:
+        """Events with rv' > rv for ``kind`` (lock held). The log is
+        rv-sorted, so the resume point is a binary search — a stream
+        wake is O(log E + batch), not a rescan of the retained log."""
+        idx = bisect.bisect_right(self._events, rv, key=lambda e: e[0])
+        return [e for e in self._events[idx:] if e[1] == kind]
+
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def apply_pending(self) -> None:
+        """Make queued bind/evict ops observable NOW (tests use this to
+        pin the visibility point that a poll's GET or a watch stream's
+        next wake would otherwise pick nondeterministically)."""
+        with self._lock:
+            self._apply_pending()
 
     @staticmethod
     def _select(items, selector: str) -> list[dict]:
@@ -179,23 +419,25 @@ class FakeApiServer:
 
     def _page(self, items: list[dict], query: dict) -> dict:
         """Apply truncation fault, then limit/continue chunking. The
-        continue token is the plain offset (opaque to clients anyway)."""
+        continue token is the plain offset (opaque to clients anyway).
+        Every page carries the list's ``resourceVersion``."""
         if self._truncate > 0:
             items = items[: self._truncate]
         offset = int(query.get("continue", ["0"])[0] or 0)
         limit = int(query.get("limit", ["0"])[0] or 0)
+        meta = {"resourceVersion": str(self._rv)}
         if limit <= 0:
-            return {"items": items[offset:]}
+            return {"items": items[offset:], "metadata": meta}
         chunk = items[offset: offset + limit]
-        doc: dict = {"items": chunk, "metadata": {}}
+        doc: dict = {"items": chunk, "metadata": meta}
         if offset + limit < len(items):
             doc["metadata"]["continue"] = str(offset + limit)
         return doc
 
     def _apply_pending(self) -> None:
-        """Bindings/evictions become observable on the next pods poll,
-        applied in POST order (a MIGRATE's evict + re-bind collapses to
-        one visible move)."""
+        """Bindings/evictions become observable on the next pods poll or
+        watch-stream wake, applied in POST order (a MIGRATE's evict +
+        re-bind collapses to one visible move)."""
         for op, pod, node in self._pending_ops:
             doc = self.pods.get(pod)
             if doc is None:
@@ -206,6 +448,7 @@ class FakeApiServer:
             else:  # evict
                 doc.setdefault("spec", {}).pop("nodeName", None)
                 doc.setdefault("status", {})["phase"] = "Pending"
+            self._emit("pods", "MODIFIED", doc)
         self._pending_ops.clear()
 
     def add_node(
@@ -219,6 +462,7 @@ class FakeApiServer:
     ) -> None:
         labels = {"rack": rack} if rack else {}
         with self._lock:
+            typ = "MODIFIED" if name in self.nodes else "ADDED"
             self.nodes[name] = {
                 "metadata": {"name": name, "labels": labels},
                 "status": {
@@ -230,6 +474,7 @@ class FakeApiServer:
                     },
                 },
             }
+            self._emit("nodes", typ, self.nodes[name])
 
     def add_pod(
         self,
@@ -250,8 +495,10 @@ class FakeApiServer:
             meta["annotations"] = {
                 "poseidon.io/data-prefs": json.dumps(data_prefs)
             }
+        key = f"{namespace}/{name}"
         with self._lock:
-            self.pods[f"{namespace}/{name}"] = {
+            typ = "MODIFIED" if key in self.pods else "ADDED"
+            self.pods[key] = {
                 "metadata": meta,
                 "spec": {
                     "containers": [
@@ -265,14 +512,62 @@ class FakeApiServer:
                 },
                 "status": {"phase": phase},
             }
+            self._emit("pods", typ, self.pods[key])
+
+    def delete_pod(self, name: str, namespace: str = "default") -> None:
+        """Remove a pod outright (k8s object deletion -> DELETED event;
+        polls simply stop listing it)."""
+        key = name if "/" in name else f"{namespace}/{name}"
+        with self._lock:
+            doc = self.pods.pop(key, None)
+            if doc is not None:
+                self._emit("pods", "DELETED", doc)
 
     def drop_node(self, name: str) -> None:
         with self._lock:
-            self.nodes.pop(name, None)
+            doc = self.nodes.pop(name, None)
+            if doc is not None:
+                self._emit("nodes", "DELETED", doc)
 
     def fail_next(self, n: int) -> None:
         with self._lock:
             self._fail_next = n
+
+    def rate_limit_next(self, n: int, retry_after_s: float = 0.05) -> None:
+        """Answer the next n requests with 429 + ``Retry-After``."""
+        with self._lock:
+            self._rate_limit_next = n
+            self._rate_limit_retry_after = retry_after_s
+
+    def disconnect_next(self, n: int) -> None:
+        """Cut the next n requests mid-body (Content-Length promised,
+        half delivered)."""
+        with self._lock:
+            self._disconnect_next = n
+
+    def gone_next_watch(self, n: int) -> None:
+        """Answer the next n watch connects with HTTP 410 Gone."""
+        with self._lock:
+            self._gone_next_watch = n
+
+    def disconnect_watch_next(self, n: int) -> None:
+        """Cut n watch streams mid-event-flow (one event delivered,
+        then the connection dies without a terminating chunk)."""
+        with self._lock:
+            self._disconnect_watch_next = n
+
+    def corrupt_next_watch(self, n: int) -> None:
+        """Emit undecodable JSON on n watch streams (the decode-error
+        degrade path)."""
+        with self._lock:
+            self._corrupt_next_watch = n
+
+    def compact_watch_log(self) -> None:
+        """Forget all watch history: any resumed rv is now too old, so
+        the next reconnect gets the in-stream 410 ERROR event."""
+        with self._lock:
+            self._compact_floor = self._rv
+            self._events.clear()
 
     def truncate_lists(self, n: int) -> None:
         """Serve only the first n items of every list, with no continue
@@ -286,3 +581,4 @@ class FakeApiServer:
             doc = self.pods.get(key)
             if doc is not None:
                 doc["status"]["phase"] = "Succeeded"
+                self._emit("pods", "MODIFIED", doc)

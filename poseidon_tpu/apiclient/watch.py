@@ -1,0 +1,497 @@
+"""Event-driven cluster observation: the Kubernetes watch protocol.
+
+The reference polls the apiserver with full ``GET /nodes`` + ``GET
+/pods`` lists every tick (k8s_api_client.cc:100-209) and re-diffs the
+whole cluster — O(cluster) host work per round even when nothing
+changed. The real control plane's answer is the watch protocol, and
+Firmament itself is built around incremental cluster-state deltas; this
+module closes that gap:
+
+- ``ClusterWatcher.sync()`` does ONE paginated LIST per resource to
+  seed a snapshot plus its ``resourceVersion``;
+- two long-lived chunked watch streams (nodes, pods) then deliver typed
+  ``ADDED | MODIFIED | DELETED | BOOKMARK`` events from that rv, each
+  stream tracking its own rv so a reconnect resumes exactly where it
+  left off (``?watch=true&resourceVersion=N`` returns events with
+  rv > N — at-most-once delivery by construction, and ``tick()``
+  re-checks ``rv <= applied`` so a replaying server cannot double-apply
+  either);
+- streams reconnect with jittered exponential backoff
+  (``client.backoff_delay``) after transport errors; clean server-side
+  closes (idle bookmark + EOF) resume immediately;
+- the watcher **degrades loudly to a full LIST resync** — never guesses
+  — on ``410 Gone`` (either HTTP shape), an undecodable event, or a
+  staleness bound (no stream activity for ``max_lag_s``, the cli's
+  ``--watch_max_lag``). Every resync and every error-path reconnect is
+  emitted as a ``WATCH_RESYNC`` / ``WATCH_RECONNECT`` trace event and
+  surfaced in ``ObserveDelta`` so the bridge counts them in
+  ``SchedulerStats``.
+
+Threading model: one daemon reader thread per stream blocks on the
+HTTP response and pushes decoded items into a per-stream queue; all
+state mutation (rv accounting, resync decisions, object parsing, trace
+emission) happens on the caller's thread inside ``tick()``, so the
+bridge — which is not thread-safe — only ever sees events from its own
+driver loop. ``tick()`` never blocks on the network except during a
+resync's LISTs.
+
+The consumer contract (cli.py, tests/test_watch.py):
+
+    delta = watcher.tick()
+    if delta.resynced:            # seed, 410, decode error, staleness
+        bridge.observe_nodes(delta.nodes)     # snapshot diff path —
+        bridge.observe_pods(delta.pods)       # mass-eviction guard on
+    else:
+        for typ, m in delta.node_events:
+            bridge.observe_node_event(typ, m)
+        for typ, t in delta.pod_events:
+            bridge.observe_pod_event(typ, t)
+    bridge.note_watch_activity(delta.resyncs, delta.reconnects)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from poseidon_tpu.apiclient.client import (
+    ApiError,
+    K8sApiClient,
+    backoff_delay,
+)
+from poseidon_tpu.cluster import Machine, Task
+from poseidon_tpu.trace import TraceGenerator
+
+log = logging.getLogger(__name__)
+
+RESOURCES = ("nodes", "pods")
+
+
+class WatchGone(Exception):
+    """The apiserver no longer holds history for the requested rv."""
+
+
+@dataclasses.dataclass
+class ObserveDelta:
+    """One ``tick()``'s worth of cluster observation.
+
+    Either a full snapshot (``resynced=True``: consume ``nodes`` /
+    ``pods`` through the bridge's snapshot-diff path) or incremental
+    typed events (``node_events`` / ``pod_events`` as ``(type, obj)``
+    pairs, type in ADDED|MODIFIED|DELETED). ``resyncs`` / ``reconnects``
+    are this tick's degradation counts for ``SchedulerStats``.
+    """
+
+    resynced: bool = False
+    nodes: list[Machine] = dataclasses.field(default_factory=list)
+    pods: list[Task] = dataclasses.field(default_factory=list)
+    node_events: list[tuple[str, Machine]] = dataclasses.field(
+        default_factory=list)
+    pod_events: list[tuple[str, Task]] = dataclasses.field(
+        default_factory=list)
+    resyncs: int = 0
+    reconnects: int = 0
+
+
+class _WatchStream(threading.Thread):
+    """One resource's watch connection, kept alive across reconnects.
+
+    Pushes ``("EVENT", rv, type, raw_object)`` / ``("BOOKMARK", rv)`` /
+    ``("RECONNECT", reason)`` / ``("GONE", reason)`` items into
+    ``self.queue``. After GONE the thread exits — only a full LIST
+    resync (which replaces the stream object) can continue.
+    """
+
+    def __init__(
+        self,
+        base: str,
+        resource: str,
+        start_rv: int,
+        *,
+        read_timeout_s: float,
+        backoff_base_s: float,
+        backoff_cap_s: float,
+    ):
+        super().__init__(daemon=True, name=f"watch-{resource}")
+        self.base = base
+        self.resource = resource
+        self.rv = start_rv        # reconnect-from rv (this thread only)
+        self.seen_rv = start_rv   # newest rv enqueued (read by others)
+        self.read_timeout_s = read_timeout_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.queue: queue.Queue = queue.Queue()
+        self.gone = threading.Event()
+        self.last_activity = time.monotonic()
+        self._halt = threading.Event()
+        self._resp = None
+
+    # ---- lifecycle ----
+
+    def stop(self) -> None:
+        self._halt.set()
+        resp = self._resp
+        if resp is not None:
+            try:
+                resp.close()  # unblocks a reader parked in readline
+            except Exception:
+                pass
+
+    # ---- the reconnect loop ----
+
+    def run(self) -> None:
+        attempt = 0
+        while not (self._halt.is_set() or self.gone.is_set()):
+            try:
+                resp = self._connect()
+            except WatchGone as e:
+                self._push_gone(str(e))
+                return
+            except (OSError, http.client.HTTPException,
+                    urllib.error.URLError) as e:
+                if self._halt.is_set():
+                    return
+                self.queue.put(
+                    ("RECONNECT", f"connect failed: {e}")
+                )
+                time.sleep(backoff_delay(
+                    attempt, base_s=self.backoff_base_s,
+                    cap_s=self.backoff_cap_s,
+                ))
+                attempt += 1
+                continue
+            self._resp = resp
+            self.last_activity = time.monotonic()
+            clean = self._consume(resp)
+            try:
+                resp.close()
+            except Exception:
+                pass
+            self._resp = None
+            if self._halt.is_set() or self.gone.is_set():
+                return
+            if clean:
+                attempt = 0  # routine idle close: resume immediately
+            else:
+                self.queue.put(("RECONNECT", "stream error"))
+                time.sleep(backoff_delay(
+                    attempt, base_s=self.backoff_base_s,
+                    cap_s=self.backoff_cap_s,
+                ))
+                attempt += 1
+
+    def _connect(self):
+        params = urllib.parse.urlencode({
+            "watch": "true",
+            "resourceVersion": str(self.rv),
+            "allowWatchBookmarks": "true",
+        })
+        url = f"{self.base}/{self.resource}?{params}"
+        try:
+            return urllib.request.urlopen(
+                urllib.request.Request(url),
+                timeout=self.read_timeout_s,
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                raise WatchGone(
+                    f"rv {self.rv} expired (HTTP 410)"
+                ) from e
+            raise
+
+    def _push_gone(self, reason: str) -> None:
+        self.gone.set()
+        self.queue.put(("GONE", reason))
+
+    def _consume(self, resp) -> bool:
+        """Decode one connection's stream; True = clean server close.
+
+        http.client's chunked reader swallows an abrupt mid-stream cut
+        (IncompleteRead surfaces as a silent EOF), so transport alone
+        cannot tell a dirty close from a server ending its watch
+        window. The protocol-level tell: a server closing *cleanly*
+        ends with a BOOKMARK (we request allowWatchBookmarks); an EOF
+        whose last delivered item was a real event means the stream
+        died mid-flow and the reconnect is counted + backed off.
+        """
+        ended_on_bookmark = False
+        try:
+            for raw in resp:
+                if self._halt.is_set():
+                    return True
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                    typ = doc["type"]
+                    obj = doc["object"]
+                except (json.JSONDecodeError, KeyError, TypeError) as e:
+                    # an undecodable stream cannot be trusted to have
+                    # delivered everything before the garbage either:
+                    # degrade loudly, never guess
+                    self._push_gone(f"undecodable watch line: {e!r}")
+                    return False
+                if typ == "ERROR":
+                    code = obj.get("code") if isinstance(obj, dict) \
+                        else None
+                    self._push_gone(
+                        "rv expired (410 ERROR event)" if code == 410
+                        else f"watch ERROR event: {obj}"
+                    )
+                    return False
+                try:
+                    rv = int(
+                        obj.get("metadata", {})
+                        .get("resourceVersion", 0) or 0
+                    )
+                except (TypeError, ValueError):
+                    rv = 0
+                self.last_activity = time.monotonic()
+                if rv > self.rv:
+                    self.rv = rv
+                if typ == "BOOKMARK":
+                    self.queue.put(("BOOKMARK", rv))
+                    ended_on_bookmark = True
+                else:
+                    self.queue.put(("EVENT", rv, typ, obj))
+                    ended_on_bookmark = False
+                # seen_rv advances only AFTER the item is enqueued:
+                # wait_caught_up readers must find the event already
+                # in the queue when they observe the new rv
+                if rv > self.seen_rv:
+                    self.seen_rv = rv
+            return ended_on_bookmark or self._halt.is_set()
+        except TimeoutError:
+            # an idle read window elapsing on a quiet stream is NOT a
+            # stream error: real apiservers space bookmarks/window
+            # closes further apart than the socket timeout, and
+            # treating the timeout as dirty would back off and count
+            # reconnects forever on a perfectly healthy idle cluster.
+            # Resume immediately from the current rv; last_activity
+            # refreshes on the reconnect, so the staleness bound only
+            # fires when a stream cannot be RE-ESTABLISHED for
+            # max_lag_s (TimeoutError must precede OSError: it is one)
+            return True
+        except (OSError, http.client.HTTPException, ValueError,
+                AttributeError):
+            # AttributeError: http.client nulls its fp when stop()
+            # closes the response under a parked readline
+            return self._halt.is_set()
+
+
+class ClusterWatcher:
+    """Holds the seed snapshot + two watch streams; see module doc."""
+
+    def __init__(
+        self,
+        client: K8sApiClient,
+        *,
+        trace: TraceGenerator | None = None,
+        max_lag_s: float = 30.0,
+        read_timeout_s: float | None = None,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+    ):
+        self.client = client
+        self.trace = trace or TraceGenerator()
+        self.max_lag_s = max_lag_s
+        self.read_timeout_s = (
+            read_timeout_s if read_timeout_s is not None
+            else client.timeout_s
+        )
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._streams: dict[str, _WatchStream] = {}
+        self._applied_rv: dict[str, int] = dict.fromkeys(RESOURCES, 0)
+        self._seeded = False
+        # a degradation whose resync LIST has not succeeded yet; kept
+        # so a failed resync is RETRIED next tick (and still counted/
+        # traced when it finally lands) instead of silently stranding
+        # the watcher with no streams
+        self._resync_reason = ""
+        # lifetime counters (per-tick deltas ride on ObserveDelta)
+        self.resyncs_total = 0
+        self.reconnects_total = 0
+
+    # ---- lifecycle ----
+
+    def stop(self) -> None:
+        for s in self._streams.values():
+            s.stop()
+        for s in self._streams.values():
+            s.join(timeout=2.0)
+        self._streams = {}
+
+    def __enter__(self) -> "ClusterWatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- sync (seed / resync) ----
+
+    def sync(self) -> tuple[list[Machine], list[Task]]:
+        """Full paginated LIST of both resources; restarts both streams
+        from the snapshot rvs. Raises ``ApiError`` if the LISTs fail
+        (the caller skips the tick, like a failed poll) — the watcher
+        stays un-seeded so the NEXT tick retries the sync rather than
+        ticking over zero streams forever."""
+        self.stop()
+        self._seeded = False
+        nodes, nodes_rv = self.client.nodes_with_rv()
+        pods, pods_rv = self.client.pods_with_rv()
+        self._applied_rv = {"nodes": nodes_rv, "pods": pods_rv}
+        for resource, rv in (("nodes", nodes_rv), ("pods", pods_rv)):
+            s = _WatchStream(
+                self.client.base, resource, rv,
+                read_timeout_s=self.read_timeout_s,
+                backoff_base_s=self.backoff_base_s,
+                backoff_cap_s=self.backoff_cap_s,
+            )
+            self._streams[resource] = s
+            s.start()
+        self._seeded = True
+        return nodes, pods
+
+    # ---- the per-tick pump ----
+
+    def tick(self) -> ObserveDelta:
+        """Drain both streams into typed events, or degrade to a full
+        resync (410 / decode error / staleness). Non-blocking except
+        during a resync's LISTs."""
+        if not self._seeded:
+            # first seed, or the retry of a resync whose LIST failed
+            reason = self._resync_reason
+            nodes, pods = self.sync()
+            if reason:
+                self._resync_reason = ""
+                self.resyncs_total += 1
+                self.trace.emit(
+                    "WATCH_RESYNC", detail={"reason": reason}
+                )
+                return ObserveDelta(
+                    resynced=True, nodes=nodes, pods=pods, resyncs=1
+                )
+            return ObserveDelta(resynced=True, nodes=nodes, pods=pods)
+        reconnects = 0
+        node_events: list[tuple[str, Machine]] = []
+        pod_events: list[tuple[str, Task]] = []
+        resync_reason = ""
+        now = time.monotonic()
+        for resource, stream in self._streams.items():
+            while True:
+                try:
+                    item = stream.queue.get_nowait()
+                except queue.Empty:
+                    break
+                kind = item[0]
+                if kind == "RECONNECT":
+                    reconnects += 1
+                    self.trace.emit(
+                        "WATCH_RECONNECT",
+                        detail={"resource": resource,
+                                "reason": item[1]},
+                    )
+                elif kind == "BOOKMARK":
+                    self._applied_rv[resource] = max(
+                        self._applied_rv[resource], item[1]
+                    )
+                elif kind == "GONE":
+                    resync_reason = resync_reason or (
+                        f"{resource}: {item[1]}"
+                    )
+                    break
+                else:  # EVENT
+                    _, rv, typ, obj = item
+                    if rv and rv <= self._applied_rv[resource]:
+                        # replayed history (reconnect overlap): a
+                        # resync-storm must never double-apply
+                        continue
+                    try:
+                        parsed = self._parse(resource, obj)
+                    except (KeyError, ValueError, TypeError) as e:
+                        resync_reason = resync_reason or (
+                            f"{resource}: unparseable {typ} event: {e!r}"
+                        )
+                        break
+                    if rv:
+                        self._applied_rv[resource] = rv
+                    if resource == "nodes":
+                        node_events.append((typ, parsed))
+                    else:
+                        pod_events.append((typ, parsed))
+            if not resync_reason and stream.gone.is_set():
+                resync_reason = f"{resource}: stream gone"
+            if not resync_reason and (
+                now - stream.last_activity > self.max_lag_s
+            ):
+                resync_reason = (
+                    f"{resource}: no stream activity for "
+                    f"{self.max_lag_s:g}s (--watch_max_lag)"
+                )
+        self.reconnects_total += reconnects
+        if resync_reason:
+            log.warning(
+                "watch degrading to full LIST resync: %s", resync_reason
+            )
+            # drained-but-unapplied events are superseded by the
+            # snapshot; dropping them cannot lose state. Recorded
+            # BEFORE the sync so a failed LIST leaves the reason (and
+            # the un-seeded state) in place for the next tick's retry.
+            self._resync_reason = resync_reason
+            nodes, pods = self.sync()
+            self._resync_reason = ""
+            self.resyncs_total += 1
+            self.trace.emit(
+                "WATCH_RESYNC", detail={"reason": resync_reason}
+            )
+            return ObserveDelta(
+                resynced=True, nodes=nodes, pods=pods,
+                resyncs=1, reconnects=reconnects,
+            )
+        return ObserveDelta(
+            node_events=node_events, pod_events=pod_events,
+            reconnects=reconnects,
+        )
+
+    def _parse(self, resource: str, obj: dict):
+        if resource == "nodes":
+            return self.client._parse_node(obj)
+        return self.client._parse_pod(obj)
+
+    # ---- test/bench helpers ----
+
+    def wait_caught_up(self, rv: int, timeout_s: float = 5.0) -> bool:
+        """Block until every stream has ENQUEUED events up to ``rv`` (or
+        gone 410, which the next ``tick`` turns into a resync). Lets
+        hermetic tests and the bench make event arrival deterministic
+        without polling ``tick`` in a loop."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(
+                s.gone.is_set() or s.seen_rv >= rv
+                for s in self._streams.values()
+            ) and self._streams:
+                return True
+            if not self._seeded:
+                return False
+            time.sleep(0.005)
+        return False
+
+
+# re-exported for callers that only import the watch module
+__all__ = [
+    "ClusterWatcher",
+    "ObserveDelta",
+    "WatchGone",
+    "ApiError",
+]

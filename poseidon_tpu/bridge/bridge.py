@@ -58,6 +58,22 @@ transition the bridge applies is mirrored into an
 previous round's builder columns instead of re-walking every task
 object (``incremental_build=False`` restores the legacy full rebuild).
 
+Event-driven observe (the watch path, apiclient/watch.py): instead of
+re-diffing a full poll snapshot every tick, drivers may feed typed
+``ADDED | MODIFIED | DELETED`` events through ``observe_node_event`` /
+``observe_pod_event``. Both paths share the same per-entity upsert and
+removal helpers, so an event drives the exact same state transitions
+and incremental-builder churn notes as the poll diff would — a
+watch-driven round is bit-identical to a poll-driven one over the same
+event history (tests/test_watch.py differential). The mass-eviction
+guard is a *snapshot* defense (an explicit DELETED event is not a
+truncated list), so events bypass it; a watch resync replays the full
+snapshot through ``observe_nodes`` / ``observe_pods`` and gets the
+guard back. Observe host time is accumulated into the next round's
+``SchedulerStats.observe_ms``, and the watcher's degradation counters
+land in ``watch_resyncs`` / ``watch_reconnects`` via
+``note_watch_activity``.
+
 Rebalancing (``enable_preemption=True``): running tasks enter the flow
 graph with a hysteresis-discounted continuation arc and a priced
 unscheduled arc (graph/builder.py rebalancing mode), and each round's
@@ -140,8 +156,18 @@ class SchedulerStats:
     # previous round (the pods were re-queued, not silently believed
     # placed)
     bind_failures: int = 0
+    # watch-mode degradation counters since the previous round: full
+    # LIST resyncs (410 Gone / decode error / staleness) and error-path
+    # stream reconnects (apiclient/watch.py; zero in poll mode)
+    watch_resyncs: int = 0
+    watch_reconnects: int = 0
     cost: int = 0
     backend: str = ""
+    # host time spent in observe_* (poll snapshot diff or watch event
+    # application) since the previous round — the observe phase the
+    # per-phase timers were missing (build/price/solve/decompose never
+    # covered the snapshot walk)
+    observe_ms: float = 0.0
     build_ms: float = 0.0
     price_ms: float = 0.0
     solve_ms: float = 0.0
@@ -237,6 +263,11 @@ class SchedulerBridge:
         ] = collections.deque(maxlen=100_000)
         self._evictions_this_round = 0
         self._bind_failures = 0
+        # per-round accumulators surfaced in SchedulerStats: observe
+        # host time and watch degradation counts since the last round
+        self._observe_ms = 0.0
+        self._watch_resyncs = 0
+        self._watch_reconnects = 0
         # consecutive implausible-shrink polls (mass-eviction guard)
         self._node_shrink_strikes = 0
         self._pod_shrink_strikes = 0
@@ -271,59 +302,95 @@ class SchedulerBridge:
 
     # ---- observation (the poll side) -----------------------------------
 
+    def _upsert_node(self, node: Machine) -> str:
+        """One node's upsert: state, churn notes, knowledge sample.
+        Shared by the poll snapshot diff and the watch event path so
+        both drive identical transitions. Returns the node name."""
+        g = self._graph
+        if node.max_tasks <= 0:
+            node = dataclasses.replace(
+                node, max_tasks=self.max_tasks_per_machine
+            )
+        prev = self.machines.get(node.name)
+        if prev is None:
+            log.info("new node %s (rack=%s)", node.name, node.rack)
+            if g:
+                g.note_full_rebuild("node added")
+        elif g and (prev.rack != node.rack
+                    or prev.max_tasks != node.max_tasks):
+            # graph-shaping attributes changed under us
+            g.note_full_rebuild("node reshaped")
+        self.machines[node.name] = node
+        cap = max(node.cpu_capacity, 1e-9)
+        mem_cap = max(node.memory_capacity_kb, 1)
+        self.knowledge.add_machine_sample(
+            node.name,
+            MachineSample(
+                cpu_idle=min(node.cpu_allocatable / cap, 1.0),
+                mem_free_frac=min(
+                    node.memory_allocatable_kb / mem_cap, 1.0
+                ),
+            ),
+        )
+        return node.name
+
+    def _remove_node(self, name: str) -> None:
+        """Release a machine: its Running tasks flip back to Pending
+        (they will be re-placed) and are logged as evictions."""
+        if name not in self.machines:
+            return
+        log.warning("node %s removed; evicting its tasks", name)
+        if self._graph:
+            self._graph.note_full_rebuild("node removed")
+        del self.machines[name]
+        self.knowledge.retire_machine(name)
+        for uid, task in list(self.tasks.items()):
+            if task.machine == name:
+                self.tasks[uid] = dataclasses.replace(
+                    task, phase=TaskPhase.PENDING, machine=""
+                )
+                self.pod_to_machine.pop(uid, None)
+                self.trace.emit("EVICT", task=uid, machine=name,
+                                round_num=self.round_num)
+                self._evictions_this_round += 1
+
     def observe_nodes(self, nodes: list[Machine]) -> None:
         """Upsert machines; release the ones that disappeared."""
-        g = self._graph
-        known_before = len(self.machines)
-        known_names = set(self.machines)
-        seen = set()
-        for node in nodes:
-            if node.max_tasks <= 0:
-                node = dataclasses.replace(
-                    node, max_tasks=self.max_tasks_per_machine
-                )
-            seen.add(node.name)
-            prev = self.machines.get(node.name)
-            if prev is None:
-                log.info("new node %s (rack=%s)", node.name, node.rack)
-                if g:
-                    g.note_full_rebuild("node added")
-            elif g and (prev.rack != node.rack
-                        or prev.max_tasks != node.max_tasks):
-                # graph-shaping attributes changed under us
-                g.note_full_rebuild("node reshaped")
-            self.machines[node.name] = node
-            cap = max(node.cpu_capacity, 1e-9)
-            mem_cap = max(node.memory_capacity_kb, 1)
-            self.knowledge.add_machine_sample(
-                node.name,
-                MachineSample(
-                    cpu_idle=min(node.cpu_allocatable / cap, 1.0),
-                    mem_free_frac=min(
-                        node.memory_allocatable_kb / mem_cap, 1.0
-                    ),
-                ),
-            )
-        gone = known_names - seen
-        if self._hold_shrink(
-            "_node_shrink_strikes", "node", known_before, len(gone)
-        ):
-            return
-        if gone and g:
-            g.note_full_rebuild("node removed")
-        for name in gone:
-            log.warning("node %s removed; evicting its tasks", name)
-            del self.machines[name]
-            self.knowledge.retire_machine(name)
-            for uid, task in list(self.tasks.items()):
-                if task.machine == name:
-                    self.tasks[uid] = dataclasses.replace(
-                        task, phase=TaskPhase.PENDING, machine=""
-                    )
-                    self.pod_to_machine.pop(uid, None)
-                    self.trace.emit("EVICT", task=uid, machine=name,
-                                    round_num=self.round_num)
-                    self._evictions_this_round += 1
+        t0 = time.perf_counter()
+        try:
+            known_before = len(self.machines)
+            known_names = set(self.machines)
+            seen = set()
+            for node in nodes:
+                seen.add(self._upsert_node(node))
+            gone = known_names - seen
+            if self._hold_shrink(
+                "_node_shrink_strikes", "node", known_before, len(gone)
+            ):
+                return
+            for name in gone:
+                self._remove_node(name)
+        finally:
+            self._observe_ms += (time.perf_counter() - t0) * 1000
+
+    def observe_node_event(
+        self, type_: str, node: Machine
+    ) -> None:
+        """Event-driven observe: one typed node event from the watch
+        stream (ADDED | MODIFIED upsert, DELETED release). Drives the
+        same transitions and churn notes as the poll diff; an explicit
+        DELETED bypasses the mass-eviction guard on purpose — the guard
+        defends against *truncated snapshots*, and an event stream
+        never infers deletion from absence (resyncs go back through
+        ``observe_nodes`` and get the guard)."""
+        t0 = time.perf_counter()
+        try:
+            if type_ == "DELETED":
+                self._remove_node(node.name)
+            else:
+                self._upsert_node(node)
+        finally:
+            self._observe_ms += (time.perf_counter() - t0) * 1000
 
     def _pending_reobserved(
         self, known: Task, pod: Task, stored: Task
@@ -343,136 +410,177 @@ class SchedulerBridge:
               or known.memory_request_kb != pod.memory_request_kb):
             g.note_task_updated(stored)
 
+    def _upsert_pod(self, pod: Task) -> str:
+        """One pod's state-machine dispatch (the reference's per-pod
+        switch, scheduler_bridge.cc:132-162). Shared by the poll
+        snapshot diff and the watch event path so both drive identical
+        transitions and churn notes. Returns the uid."""
+        g = self._graph
+        known = self.tasks.get(pod.uid)
+        if pod.phase == TaskPhase.PENDING:
+            if known is None:
+                log.info("new pending pod %s", pod.uid)
+                self.trace.emit("SUBMIT", task=pod.uid,
+                                round_num=self.round_num)
+                self.tasks[pod.uid] = pod
+                if g:
+                    g.note_task_added(pod)
+            elif (
+                known.phase == TaskPhase.RUNNING and known.machine
+            ):
+                # a locally-confirmed binding outlives apiserver
+                # poll latency: the pod still reads Pending until
+                # the watch cache catches up, and downgrading here
+                # would re-schedule it (double-binding + the slot
+                # discount lost)
+                pass
+            else:
+                # keep our aging counter across polls
+                stored = dataclasses.replace(
+                    pod, wait_rounds=known.wait_rounds
+                )
+                if known.phase != TaskPhase.PENDING:
+                    if g:
+                        g.note_full_rebuild("pod re-entered pending")
+                else:
+                    self._pending_reobserved(known, pod, stored)
+                self.tasks[pod.uid] = stored
+        elif pod.phase == TaskPhase.RUNNING:
+            if pod.machine and pod.machine not in self.machines:
+                # The apiserver still reports a binding to a node we
+                # no longer know (removed in observe_nodes). Adopting
+                # it would silently undo the eviction and park the
+                # pod on a ghost machine forever; keep it Pending
+                # (aging preserved) so the next round re-places it.
+                log.warning(
+                    "pod %s bound to unknown node %s; keeping it "
+                    "Pending for re-placement", pod.uid, pod.machine,
+                )
+                wait = known.wait_rounds if known is not None else 0
+                stored = dataclasses.replace(
+                    pod, phase=TaskPhase.PENDING, machine="",
+                    wait_rounds=wait,
+                )
+                if known is None:
+                    if g:
+                        g.note_task_added(stored)
+                elif known.phase == TaskPhase.PENDING:
+                    self._pending_reobserved(known, pod, stored)
+                elif g:
+                    g.note_full_rebuild("pod re-entered pending")
+                self.tasks[pod.uid] = stored
+                self.pod_to_machine.pop(pod.uid, None)
+                return pod.uid
+            if known is None or known.machine != pod.machine:
+                # restart reconcile: adopt the apiserver's binding
+                # instead of the reference's CHECK-crash
+                # (scheduler_bridge.cc:146-147)
+                log.info(
+                    "adopting running pod %s on %s",
+                    pod.uid, pod.machine,
+                )
+            # the poll carries no aging (wait_rounds is bridge-
+            # internal): preserve it so a later preemption parks
+            # the pod with its starvation pressure intact
+            stored = (
+                dataclasses.replace(
+                    pod, wait_rounds=known.wait_rounds
+                )
+                if known is not None else pod
+            )
+            if g:
+                if known is not None and known.phase == TaskPhase.PENDING:
+                    g.note_task_removed(pod.uid)
+                was_on = (
+                    known.machine
+                    if known is not None
+                    and known.phase == TaskPhase.RUNNING else ""
+                )
+                if self.enable_preemption:
+                    self._running_reobserved(
+                        known, pod, stored, was_on
+                    )
+                elif was_on != pod.machine:
+                    if was_on and was_on in self.machines:
+                        g.note_slots_changed(was_on, -1)
+                    if pod.machine:
+                        g.note_slots_changed(pod.machine, +1)
+            self.tasks[pod.uid] = stored
+            if pod.machine:
+                self.pod_to_machine[pod.uid] = pod.machine
+            self.knowledge.add_task_sample(
+                pod.uid,
+                TaskSample(
+                    cpu_usage=pod.cpu_request,
+                    mem_usage_kb=pod.memory_request_kb,
+                ),
+            )
+        else:  # Succeeded / Failed / Unknown: retire, free the slot
+            if known is not None:
+                log.info("retiring pod %s (%s)", pod.uid, pod.phase)
+                self.trace.emit("FINISH", task=pod.uid,
+                                machine=known.machine,
+                                round_num=self.round_num,
+                                detail={"phase": str(pod.phase.value)})
+                self._retire_notes(known)
+                self.tasks.pop(pod.uid, None)
+                self.pod_to_machine.pop(pod.uid, None)
+                self.knowledge.retire_task(pod.uid)
+        return pod.uid
+
+    def _remove_pod(self, uid: str) -> None:
+        """A pod left the cluster without a terminal phase (poll: gone
+        from the snapshot; watch: an explicit DELETED event): retire it
+        silently — no FINISH event, matching the poll diff."""
+        task = self.tasks.pop(uid, None)
+        if task is not None:
+            self._retire_notes(task)
+        self.pod_to_machine.pop(uid, None)
+        self.knowledge.retire_task(uid)
+
     def observe_pods(self, pods: list[Task]) -> None:
         """The reference's per-pod dispatch (scheduler_bridge.cc:132-162),
         with restart reconcile and terminal-state retirement."""
-        g = self._graph
-        known_before = len(self.tasks)
-        known_uids = set(self.tasks)
-        seen = set()
-        for pod in pods:
-            seen.add(pod.uid)
-            known = self.tasks.get(pod.uid)
-            if pod.phase == TaskPhase.PENDING:
-                if known is None:
-                    log.info("new pending pod %s", pod.uid)
-                    self.trace.emit("SUBMIT", task=pod.uid,
-                                    round_num=self.round_num)
-                    self.tasks[pod.uid] = pod
-                    if g:
-                        g.note_task_added(pod)
-                elif (
-                    known.phase == TaskPhase.RUNNING and known.machine
-                ):
-                    # a locally-confirmed binding outlives apiserver
-                    # poll latency: the pod still reads Pending until
-                    # the watch cache catches up, and downgrading here
-                    # would re-schedule it (double-binding + the slot
-                    # discount lost)
-                    pass
-                else:
-                    # keep our aging counter across polls
-                    stored = dataclasses.replace(
-                        pod, wait_rounds=known.wait_rounds
-                    )
-                    if known.phase != TaskPhase.PENDING:
-                        if g:
-                            g.note_full_rebuild("pod re-entered pending")
-                    else:
-                        self._pending_reobserved(known, pod, stored)
-                    self.tasks[pod.uid] = stored
-            elif pod.phase == TaskPhase.RUNNING:
-                if pod.machine and pod.machine not in self.machines:
-                    # The apiserver still reports a binding to a node we
-                    # no longer know (removed in observe_nodes). Adopting
-                    # it would silently undo the eviction and park the
-                    # pod on a ghost machine forever; keep it Pending
-                    # (aging preserved) so the next round re-places it.
-                    log.warning(
-                        "pod %s bound to unknown node %s; keeping it "
-                        "Pending for re-placement", pod.uid, pod.machine,
-                    )
-                    wait = known.wait_rounds if known is not None else 0
-                    stored = dataclasses.replace(
-                        pod, phase=TaskPhase.PENDING, machine="",
-                        wait_rounds=wait,
-                    )
-                    if known is None:
-                        if g:
-                            g.note_task_added(stored)
-                    elif known.phase == TaskPhase.PENDING:
-                        self._pending_reobserved(known, pod, stored)
-                    elif g:
-                        g.note_full_rebuild("pod re-entered pending")
-                    self.tasks[pod.uid] = stored
-                    self.pod_to_machine.pop(pod.uid, None)
-                    continue
-                if known is None or known.machine != pod.machine:
-                    # restart reconcile: adopt the apiserver's binding
-                    # instead of the reference's CHECK-crash
-                    # (scheduler_bridge.cc:146-147)
-                    log.info(
-                        "adopting running pod %s on %s",
-                        pod.uid, pod.machine,
-                    )
-                # the poll carries no aging (wait_rounds is bridge-
-                # internal): preserve it so a later preemption parks
-                # the pod with its starvation pressure intact
-                stored = (
-                    dataclasses.replace(
-                        pod, wait_rounds=known.wait_rounds
-                    )
-                    if known is not None else pod
-                )
-                if g:
-                    if known is not None and known.phase == TaskPhase.PENDING:
-                        g.note_task_removed(pod.uid)
-                    was_on = (
-                        known.machine
-                        if known is not None
-                        and known.phase == TaskPhase.RUNNING else ""
-                    )
-                    if self.enable_preemption:
-                        self._running_reobserved(
-                            known, pod, stored, was_on
-                        )
-                    elif was_on != pod.machine:
-                        if was_on and was_on in self.machines:
-                            g.note_slots_changed(was_on, -1)
-                        if pod.machine:
-                            g.note_slots_changed(pod.machine, +1)
-                self.tasks[pod.uid] = stored
-                if pod.machine:
-                    self.pod_to_machine[pod.uid] = pod.machine
-                self.knowledge.add_task_sample(
-                    pod.uid,
-                    TaskSample(
-                        cpu_usage=pod.cpu_request,
-                        mem_usage_kb=pod.memory_request_kb,
-                    ),
-                )
-            else:  # Succeeded / Failed / Unknown: retire, free the slot
-                if known is not None:
-                    log.info("retiring pod %s (%s)", pod.uid, pod.phase)
-                    self.trace.emit("FINISH", task=pod.uid,
-                                    machine=known.machine,
-                                    round_num=self.round_num,
-                                    detail={"phase": str(pod.phase.value)})
-                    self._retire_notes(known)
-                    self.tasks.pop(pod.uid, None)
-                    self.pod_to_machine.pop(pod.uid, None)
-                    self.knowledge.retire_task(pod.uid)
-        gone = known_uids - seen
-        if self._hold_shrink(
-            "_pod_shrink_strikes", "pod", known_before, len(gone)
-        ):
-            return
-        for uid in gone:
-            task = self.tasks.pop(uid, None)
-            if task is not None:
-                self._retire_notes(task)
-            self.pod_to_machine.pop(uid, None)
-            self.knowledge.retire_task(uid)
+        t0 = time.perf_counter()
+        try:
+            known_before = len(self.tasks)
+            known_uids = set(self.tasks)
+            seen = set()
+            for pod in pods:
+                seen.add(self._upsert_pod(pod))
+            gone = known_uids - seen
+            if self._hold_shrink(
+                "_pod_shrink_strikes", "pod", known_before, len(gone)
+            ):
+                return
+            for uid in gone:
+                self._remove_pod(uid)
+        finally:
+            self._observe_ms += (time.perf_counter() - t0) * 1000
+
+    def observe_pod_event(self, type_: str, pod: Task) -> None:
+        """Event-driven observe: one typed pod event from the watch
+        stream. ADDED | MODIFIED run the normal per-pod dispatch
+        (which already handles every phase, including terminal ones);
+        DELETED retires the pod like a poll disappearance. Explicit
+        deletions bypass the mass-eviction guard by design — see
+        ``observe_node_event``."""
+        t0 = time.perf_counter()
+        try:
+            if type_ == "DELETED":
+                self._remove_pod(pod.uid)
+            else:
+                self._upsert_pod(pod)
+        finally:
+            self._observe_ms += (time.perf_counter() - t0) * 1000
+
+    def note_watch_activity(
+        self, resyncs: int = 0, reconnects: int = 0
+    ) -> None:
+        """Driver reports the watcher's degradation counts for this
+        tick; they surface in the next round's ``SchedulerStats``."""
+        self._watch_resyncs += resyncs
+        self._watch_reconnects += reconnects
 
     def _running_reobserved(
         self, known: Task | None, pod: Task, stored: Task, was_on: str
@@ -553,6 +661,12 @@ class SchedulerBridge:
         self._evictions_this_round = 0
         stats.bind_failures = self._bind_failures
         self._bind_failures = 0
+        stats.observe_ms = round(self._observe_ms, 3)
+        self._observe_ms = 0.0
+        stats.watch_resyncs = self._watch_resyncs
+        self._watch_resyncs = 0
+        stats.watch_reconnects = self._watch_reconnects
+        self._watch_reconnects = 0
         t_start = time.perf_counter()
 
         cluster = self.cluster_state()

@@ -32,7 +32,18 @@ Differences from the reference loop, on purpose:
   Pipelined binding POSTs are confirmed optimistically (the bridge
   marks the pod Running when the round finishes, the POST follows in
   the next tick's overlap window); a failed POST revokes the binding
-  so the pod is re-offered.
+  so the pod is re-offered;
+- ``--watch=true`` replaces the full-list poll with the Kubernetes
+  watch protocol (apiclient/watch.py): one seeding LIST, then typed
+  ADDED/MODIFIED/DELETED events streamed from a ``resourceVersion``
+  feed ``observe_node_event`` / ``observe_pod_event`` directly — the
+  observe phase becomes O(churn) instead of O(cluster), closing the
+  last full-cluster scan in the round. The watcher degrades loudly to
+  a full LIST resync (replayed through the snapshot-diff path, mass-
+  eviction guard intact) on 410 Gone, decode errors, or
+  ``--watch_max_lag`` seconds without stream activity; resyncs and
+  reconnects are trace events and ``SchedulerStats`` counters. Watch
+  composes with ``--round_pipeline`` and ``--enable_preemption``.
 
 Run: ``python -m poseidon_tpu.cli --k8s_apiserver_port=8080
 --flow_scheduling_cost_model=quincy --max_rounds=0``
@@ -84,6 +95,19 @@ def build_parser() -> argparse.ArgumentParser:
                    default="true", choices=["true", "false"],
                    help="O(churn) delta graph builds across rounds; "
                         "false = full rebuild every round")
+    # event-driven observe: the k8s watch protocol instead of full
+    # GET /nodes + GET /pods lists every tick — the reference's
+    # O(cluster) poll (k8s_api_client.cc:100-209) becomes O(churn)
+    p.add_argument("--watch",
+                   default="false", choices=["true", "false"],
+                   help="observe the cluster via watch streams "
+                        "(ADDED/MODIFIED/DELETED events from a "
+                        "resourceVersion) instead of full-list polls; "
+                        "falls back to a full LIST resync on 410 Gone, "
+                        "decode errors, or staleness")
+    p.add_argument("--watch_max_lag", type=float, default=30.0,
+                   help="seconds without watch-stream activity before "
+                        "degrading to a full LIST resync")
     # rebalancing: the full SchedulingDelta vocabulary (PLACE /
     # MIGRATE / PREEMPT / NOOP) — running pods get a hysteresis-
     # discounted continuation arc and a priced unscheduled arc, and
@@ -268,6 +292,45 @@ def run_loop(args: argparse.Namespace) -> int:
     incremental = args.run_incremental_scheduler == "true"
     pipelined = args.round_pipeline == "true"
     stats_fh = open(args.stats_json, "a") if args.stats_json else None
+    watcher = None
+    if args.watch == "true":
+        from poseidon_tpu.apiclient.watch import ClusterWatcher
+
+        watcher = ClusterWatcher(
+            client,
+            trace=bridge.trace,
+            max_lag_s=args.watch_max_lag,
+        )
+
+    def _observe_tick() -> bool:
+        """One tick's cluster observation; False = skip the tick."""
+        if watcher is None:
+            try:
+                nodes = client.all_nodes()
+                pods = client.all_pods()
+            except ApiError as e:
+                log.error("poll failed, skipping tick: %s", e)
+                return False
+            bridge.observe_nodes(nodes)
+            bridge.observe_pods(pods)
+            return True
+        try:
+            delta = watcher.tick()
+        except ApiError as e:
+            log.error("watch sync failed, skipping tick: %s", e)
+            return False
+        if delta.resynced:
+            # full snapshot: replay the poll-diff path (mass-eviction
+            # guard included)
+            bridge.observe_nodes(delta.nodes)
+            bridge.observe_pods(delta.pods)
+        else:
+            for typ, machine in delta.node_events:
+                bridge.observe_node_event(typ, machine)
+            for typ, task in delta.pod_events:
+                bridge.observe_pod_event(typ, task)
+        bridge.note_watch_activity(delta.resyncs, delta.reconnects)
+        return True
 
     rounds = 0
     # round-pipeline state: at most one solve in flight across ticks,
@@ -326,15 +389,9 @@ def run_loop(args: argparse.Namespace) -> int:
     try:
         while True:
             tick_start = time.perf_counter()
-            try:
-                nodes = client.all_nodes()
-                pods = client.all_pods()
-            except ApiError as e:
-                log.error("poll failed, skipping tick: %s", e)
+            if not _observe_tick():
                 time.sleep(args.polling_frequency / 1e6)
                 continue
-            bridge.observe_nodes(nodes)
-            bridge.observe_pods(pods)
             if not incremental and not pipelined:
                 bridge.warm_state = None
             try:
@@ -415,6 +472,8 @@ def run_loop(args: argparse.Namespace) -> int:
                 max(args.polling_frequency / 1e6 - elapsed, 0.0)
             )
     finally:
+        if watcher is not None:
+            watcher.stop()
         if stats_fh:
             stats_fh.close()
         if trace_fh:

@@ -9,7 +9,10 @@ scheduler event, with an injectable clock so tests are deterministic.
 Event types mirror the cluster-trace vocabulary: SUBMIT (pod observed),
 SCHEDULE (placement decision), MIGRATE (rebalancing move, ``detail.
 from`` names the old machine), PREEMPT (rebalancing park), EVICT (node
-loss), FINISH (pod retired),
+loss), FINISH (pod retired), WATCH_RESYNC (the watch subsystem degraded
+to a full LIST resync — ``detail.reason`` names why: 410 Gone, decode
+error, or staleness) and WATCH_RECONNECT (an error-path watch-stream
+reconnect, ``detail.resource``/``detail.reason``),
 plus ROUND records carrying the per-phase timing/stat payload
 (``SchedulerStats`` as a dict — including the round-pipeline timers:
 ``build_mode`` delta/full/legacy, ``dispatch_ms``, ``fetch_wait_ms``,
@@ -35,7 +38,8 @@ from typing import Callable, IO
 class TraceEvent:
     timestamp_us: int
     event: str              # SUBMIT | SCHEDULE | MIGRATE | PREEMPT |
-                            # EVICT | FINISH | ROUND
+                            # EVICT | FINISH | ROUND | WATCH_RESYNC |
+                            # WATCH_RECONNECT
     task: str = ""
     machine: str = ""
     round_num: int = 0

@@ -1,0 +1,99 @@
+"""Client retry policy: jittered exponential backoff, honest 4xx.
+
+The old ``_request`` slept a fixed linear ``0.05·(attempt+1)`` and —
+because ``HTTPError`` is an ``OSError`` — burned every retry on
+non-retryable 4xx answers. These tests pin the fixed policy:
+
+- 404/4xx fail FAST (one request, no retries);
+- 429 is retried and its ``Retry-After`` respected as a delay floor;
+- 5xx and transport errors (including a connection cut mid-body, the
+  ``disconnect_next`` injection) are retried;
+- the delay schedule is exponential with a cap and multiplicative
+  [0.5, 1.5) jitter.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from poseidon_tpu.apiclient import FakeApiServer, K8sApiClient
+from poseidon_tpu.apiclient.client import ApiError, backoff_delay
+
+
+class TestBackoffDelay:
+    def test_exponential_with_cap(self):
+        # jitter pinned to 1.0 (rng() == 0.5)
+        flat = lambda: 0.5  # noqa: E731
+        assert backoff_delay(0, base_s=0.05, cap_s=2.0, rng=flat) == \
+            pytest.approx(0.05)
+        assert backoff_delay(1, base_s=0.05, cap_s=2.0, rng=flat) == \
+            pytest.approx(0.10)
+        assert backoff_delay(3, base_s=0.05, cap_s=2.0, rng=flat) == \
+            pytest.approx(0.40)
+        # capped: 0.05 * 2^10 >> 2.0
+        assert backoff_delay(10, base_s=0.05, cap_s=2.0, rng=flat) == \
+            pytest.approx(2.0)
+
+    def test_jitter_range(self):
+        lo = backoff_delay(2, base_s=0.1, cap_s=5.0, rng=lambda: 0.0)
+        hi = backoff_delay(2, base_s=0.1, cap_s=5.0,
+                           rng=lambda: 0.999999)
+        assert lo == pytest.approx(0.4 * 0.5)
+        assert hi < 0.4 * 1.5
+        assert lo < hi
+
+
+class TestRequestRetries:
+    def _client(self, server, **kw):
+        kw.setdefault("retries", 2)
+        kw.setdefault("backoff_base_s", 0.01)
+        return K8sApiClient("127.0.0.1", server.port, **kw)
+
+    def test_404_fails_fast_without_retries(self):
+        with FakeApiServer() as server:
+            client = self._client(server)
+            before = server.requests_served
+            with pytest.raises(ApiError, match="HTTP 404"):
+                client._request("no-such-resource")
+            # one request, zero retries: 4xx cannot heal
+            assert server.requests_served == before + 1
+
+    def test_429_is_retried_with_retry_after_floor(self):
+        with FakeApiServer() as server:
+            server.add_node("n0")
+            server.rate_limit_next(2, retry_after_s=0.05)
+            client = self._client(server)
+            t0 = time.perf_counter()
+            nodes = client.all_nodes()
+            waited = time.perf_counter() - t0
+            assert [n.name for n in nodes] == ["n0"]
+            assert server.requests_served == 3  # 429, 429, 200
+            # two Retry-After floors of 50 ms each were respected
+            assert waited >= 0.1
+
+    def test_mid_body_disconnect_is_retried(self):
+        with FakeApiServer() as server:
+            server.add_node("n0")
+            server.disconnect_next(1)
+            client = self._client(server)
+            assert [n.name for n in client.all_nodes()] == ["n0"]
+            assert server.requests_served == 2
+
+    def test_500_exhaustion_raises(self):
+        with FakeApiServer() as server:
+            server.add_node("n0")
+            server.fail_next(5)
+            client = self._client(server)  # retries=2 -> 3 attempts
+            before = server.requests_served
+            with pytest.raises(ApiError):
+                client.all_nodes()
+            assert server.requests_served == before + 3
+
+    def test_500_heals_within_budget(self):
+        with FakeApiServer() as server:
+            server.add_node("n0")
+            server.fail_next(2)
+            client = self._client(server)
+            assert [n.name for n in client.all_nodes()] == ["n0"]

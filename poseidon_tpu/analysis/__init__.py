@@ -1,0 +1,47 @@
+"""Contract linter: AST analysis enforcing this repo's performance and
+concurrency invariants.
+
+The codebase *states* its contracts — rounds are O(churn), the resident
+round is one fused program with exactly one host sync, the bridge is
+single-threaded with documented cross-thread handoffs — but a contract
+nobody checks is a comment. This package makes them machine-checked:
+
+- ``python -m poseidon_tpu.analysis`` runs every registered rule over
+  the shipped tree (``poseidon_tpu/``, ``bench.py``, ``scripts/``) and
+  exits non-zero on any violation; CI runs it as a blocking step.
+- Rules are repo-specific, declared against ``contracts.py`` (the hot-
+  path scopes, the cluster-sized collection names, the thread classes
+  and their documented handoff points, the trace vocabulary and flag
+  surface). See ``rules.py`` for the rule set (PTA001-PTA005) with
+  bad/good examples.
+- Violations are suppressed inline with ``# noqa: PTA001 -- reason``;
+  the reason is REQUIRED (a bare suppression is itself a violation,
+  PTA000) so every sanctioned exception documents why it is sanctioned.
+
+The static pass pairs with runtime teeth in ``poseidon_tpu/guards.py``
+(``jax.transfer_guard`` around the resident round, a compile counter
+for the recompile budget, the fetch deadline) — the linter catches the
+pattern at review time, the guards catch whatever slips through at run
+time.
+"""
+
+from poseidon_tpu.analysis.contracts import Contracts, DEFAULT_CONTRACTS
+from poseidon_tpu.analysis.core import (
+    Violation,
+    analyze_file,
+    analyze_tree,
+    default_targets,
+    format_human,
+    format_json,
+)
+
+__all__ = [
+    "Contracts",
+    "DEFAULT_CONTRACTS",
+    "Violation",
+    "analyze_file",
+    "analyze_tree",
+    "default_targets",
+    "format_human",
+    "format_json",
+]

@@ -1,0 +1,71 @@
+"""CLI entry: ``python -m poseidon_tpu.analysis [--format=...] [paths]``.
+
+Exit codes: 0 clean, 1 violations found, 2 usage error. CI runs
+``python -m poseidon_tpu.analysis --format=json`` as a blocking step
+(after ruff, before the test suite).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from poseidon_tpu.analysis.core import (
+    analyze_tree,
+    format_human,
+    format_json,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m poseidon_tpu.analysis",
+        description=(
+            "Contract linter: enforce the repo's hot-path, O(churn), "
+            "jit-hygiene, thread-discipline, and surface-consistency "
+            "invariants (rules PTA001-PTA005; see analysis/rules.py)"
+        ),
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files to scan (default: the shipped tree — "
+             "poseidon_tpu/, scripts/, bench.py)",
+    )
+    p.add_argument(
+        "--format", choices=("human", "json"), default="human",
+        help="output format (json for CI)",
+    )
+    p.add_argument(
+        "--root", default=".",
+        help="repo root (scopes and doc files resolve against it)",
+    )
+    args = p.parse_args(argv)
+
+    root = pathlib.Path(args.root).resolve()
+    paths = None
+    if args.paths:
+        paths = []
+        for raw in args.paths:
+            path = pathlib.Path(raw).resolve()
+            if not path.exists():
+                print(f"no such file: {raw}", file=sys.stderr)
+                return 2
+            if not path.is_relative_to(root):
+                print(
+                    f"{raw} is outside --root {root} (scopes are "
+                    "declared root-relative)", file=sys.stderr,
+                )
+                return 2
+            if path.is_dir():
+                paths.extend(sorted(path.rglob("*.py")))
+            else:
+                paths.append(path)
+    violations, files_scanned = analyze_tree(root, paths)
+    formatter = format_json if args.format == "json" else format_human
+    print(formatter(violations, files_scanned))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

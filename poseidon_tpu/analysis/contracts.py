@@ -1,0 +1,176 @@
+"""The declared contracts the lint rules check against.
+
+Everything repo-specific lives here, separate from the rule logic, so
+(a) a reviewer can see the whole enforced surface in one file and
+(b) the analyzer tests can run the same rules against synthetic
+contracts pointed at snippet trees.
+
+Paths are repo-root-relative POSIX suffixes: a file matches a scope
+entry when its normalized path ENDS WITH the entry, so the same
+contracts work on the real tree and on a test-built mirror of it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadContract:
+    """PTA004 per-class declaration.
+
+    ``lock_attr`` names the designated lock (``with self.<lock_attr>:``
+    satisfies the rule at a conflicting access site). ``handoffs`` maps
+    attribute name -> the documented reason the cross-thread access is
+    safe WITHOUT the lock (a queue, an Event happens-before pair, a
+    benign-race close). Background contexts are not listed here — they
+    are declared next to the code with a ``# pta: background-thread``
+    marker comment on the ``def`` line, so the declaration cannot drift
+    from the thread that actually runs the function.
+    """
+
+    lock_attr: str = "_lock"
+    handoffs: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class Contracts:
+    """The full declared surface consumed by the rules."""
+
+    # ---- PTA001: hot-path scopes (no host syncs) ----------------------
+    # whole files whose every function is hot
+    hot_path_files: tuple[str, ...] = ()
+    # path suffix -> qualified function names ("Class.method"); nested
+    # functions inherit their enclosing scope
+    hot_path_functions: dict[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+    # dotted-name prefixes / bare callables whose results are device
+    # arrays (the int()/float() taint sources)
+    device_producers: tuple[str, ...] = ()
+    # producers excluded from taint even though they match a prefix
+    # (jax.device_get RESULTS are host arrays)
+    device_producer_exceptions: tuple[str, ...] = ()
+
+    # ---- PTA002: O(churn) scopes (no cluster-sized loops) -------------
+    ochurn_functions: dict[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+    # terminal attribute/variable names that hold cluster-sized
+    # collections (iterating one of these in an O(churn) scope flags)
+    cluster_sized_names: tuple[str, ...] = ()
+
+    # ---- PTA004: thread discipline ------------------------------------
+    thread_classes: dict[str, ThreadContract] = dataclasses.field(
+        default_factory=dict
+    )
+
+    # ---- PTA005: trace vocabulary + flag surface ----------------------
+    trace_module: str = "poseidon_tpu/trace.py"
+    trace_vocab_name: str = "EVENT_TYPES"
+    flag_module: str = "poseidon_tpu/cli.py"
+    flag_doc_files: tuple[str, ...] = ("README.md", "deploy/poseidon-tpu.cfg")
+
+
+# The marker comment declaring a function runs on a background thread
+# (PTA004). Lives on the ``def`` line:  def run(self):  # pta: background-thread
+BACKGROUND_MARKER = "pta: background-thread"
+
+
+DEFAULT_CONTRACTS = Contracts(
+    hot_path_files=(
+        # the whole resident round is the hot path: ONE upload, ONE
+        # fused program, ONE sanctioned fetch (module docstring)
+        "poseidon_tpu/ops/resident.py",
+    ),
+    hot_path_functions={
+        # the incremental-build path: O(churn) numpy patching, never a
+        # device sync
+        "poseidon_tpu/graph/builder.py": (
+            "IncrementalFlowGraphBuilder.build_arrays",
+            "IncrementalFlowGraphBuilder._apply_deltas",
+        ),
+        # the begin_round -> finish_round window the pipelined driver
+        # overlaps host work under
+        "poseidon_tpu/bridge/bridge.py": (
+            "SchedulerBridge.begin_round",
+            "SchedulerBridge.finish_round",
+        ),
+    },
+    device_producers=(
+        "jnp.",
+        "jax.",
+        # the fused resident chain + its jitted pieces
+        "_resident_chain",
+        "_redensify",
+        "_finalize",
+        "_solve",
+        "_densify",
+        "cold_start",
+        "model_fn",
+        "_jitted_model",
+    ),
+    device_producer_exceptions=(
+        "jax.device_get",   # result is HOST data
+    ),
+    ochurn_functions={
+        "poseidon_tpu/bridge/bridge.py": (
+            "SchedulerBridge.begin_round",
+            "SchedulerBridge.finish_round",
+        ),
+        "poseidon_tpu/graph/builder.py": (
+            "IncrementalFlowGraphBuilder.build_arrays",
+            "IncrementalFlowGraphBuilder._apply_deltas",
+        ),
+        "poseidon_tpu/ops/resident.py": (
+            "ResidentSolver.begin_round",
+            "ResidentSolver.finish_round",
+        ),
+    },
+    cluster_sized_names=(
+        "tasks",
+        "machines",
+        "pods",
+        "nodes",
+        "pending",
+        "task_uids",
+        "machine_names",
+        "pod_to_machine",
+    ),
+    thread_classes={
+        # The bridge is single-threaded BY CONTRACT: no background
+        # context may mutate it at all (any marker-declared background
+        # function writing bridge state must hold the lock — and there
+        # is deliberately no lock, so the right fix is a handoff
+        # through the driver loop).
+        "SchedulerBridge": ThreadContract(lock_attr="_lock", handoffs={}),
+        "ResidentSolver": ThreadContract(lock_attr="_lock", handoffs={}),
+        # resident.py's single-shot fetch handle: the Event set/wait
+        # pair is the documented happens-before edge
+        "_AsyncFetch": ThreadContract(
+            lock_attr="_lock",
+            handoffs={
+                "_value": "written before _done.set(); read only after "
+                          "_done.wait() — Event establishes happens-before",
+                "_exc": "same Event happens-before as _value",
+            },
+        ),
+        # watch.py's per-resource reader thread
+        "_WatchStream": ThreadContract(
+            lock_attr="_lock",
+            handoffs={
+                "_resp": "benign race with stop(): closing a stale "
+                         "response object at worst forces one counted "
+                         "reconnect; queue.Queue carries the real data",
+                "rv": "reader-thread-private reconnect cursor; main "
+                      "thread never reads it",
+                "seen_rv": "monotonic int advanced only after the event "
+                           "is enqueued; torn reads impossible on a GIL "
+                           "int, staleness means one extra wait loop",
+                "last_activity": "monotonic float heartbeat; a stale "
+                                 "read only delays the staleness resync "
+                                 "by one tick",
+            },
+        ),
+    },
+)

@@ -1,0 +1,300 @@
+"""The lint framework: rule registry, suppressions, file walking, output.
+
+Rule logic lives in ``rules.py``; the repo-specific declarations in
+``contracts.py``. This module owns everything rule-agnostic:
+
+- ``FileContext``: one parsed file (AST + comment map + suppression
+  map + background-thread markers), built once and shared by every
+  file-scoped rule;
+- ``RepoContext``: every parsed file plus the repo root, for rules
+  that check cross-file surfaces (PTA005);
+- suppressions: ``# noqa: PTA001 -- reason`` on the violation's line.
+  The reason is mandatory — a bare ``# noqa: PTA001`` is itself
+  reported as PTA000 (suppression-hygiene), so CI fails until the
+  author writes down WHY the exception is sanctioned;
+- output: human one-line-per-violation or a JSON document for CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import pathlib
+import re
+import tokenize
+from typing import Callable
+
+from poseidon_tpu.analysis.contracts import (
+    BACKGROUND_MARKER,
+    Contracts,
+    DEFAULT_CONTRACTS,
+)
+
+# files/dirs never scanned
+_SKIP_DIRS = {"__pycache__", ".git", "build", "build-asan", "build-tsan"}
+
+# ``# noqa: PTA001 -- reason`` / ``# noqa: PTA001,PTA004 -- reason``.
+# Only PTA codes are claimed; plain ``# noqa`` lines belong to ruff.
+_NOQA_RE = re.compile(
+    r"#\s*noqa:\s*(?P<codes>PTA\d{3}(?:\s*,\s*PTA\d{3})*)"
+    r"(?:\s*--\s*(?P<reason>\S.*))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    code: str          # "PTA001"
+    rule: str          # "no-host-sync"
+    path: str          # repo-root-relative POSIX path
+    line: int
+    col: int
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FileContext:
+    """One parsed source file plus everything the rules derive from it."""
+
+    path: str                       # repo-relative POSIX
+    source: str
+    tree: ast.AST
+    comments: dict[int, str]        # line -> comment text
+    suppressions: dict[int, set[str]]   # line -> suppressed PTA codes
+    background_lines: set[int]      # lines carrying the PTA004 marker
+    contracts: Contracts
+
+    def in_scope(self, scopes: dict[str, tuple[str, ...]],
+                 qualname: str) -> bool:
+        """True when ``qualname`` (dot-joined def nesting, no class
+        dots collapsed) matches a declared scope for this file. A
+        nested function inherits its enclosing function's scope."""
+        for suffix, names in scopes.items():
+            if not self.path.endswith(suffix):
+                continue
+            for name in names:
+                if qualname == name or qualname.startswith(name + "."):
+                    return True
+        return False
+
+
+@dataclasses.dataclass
+class RepoContext:
+    root: pathlib.Path
+    files: dict[str, FileContext]   # repo-relative path -> context
+    contracts: Contracts
+
+    def read_text(self, rel: str) -> str | None:
+        p = self.root / rel
+        try:
+            return p.read_text()
+        except OSError:
+            return None
+
+
+FileRule = Callable[[FileContext], list[Violation]]
+RepoRule = Callable[[RepoContext], list[Violation]]
+
+FILE_RULES: list[tuple[str, str, FileRule]] = []
+REPO_RULES: list[tuple[str, str, RepoRule]] = []
+
+
+def file_rule(code: str, name: str):
+    def deco(fn: FileRule) -> FileRule:
+        FILE_RULES.append((code, name, fn))
+        return fn
+    return deco
+
+
+def repo_rule(code: str, name: str):
+    def deco(fn: RepoRule) -> RepoRule:
+        REPO_RULES.append((code, name, fn))
+        return fn
+    return deco
+
+
+# ---- parsing -----------------------------------------------------------
+
+
+def _scan_comments(source: str) -> dict[int, str]:
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):
+        pass  # the ast.parse error is the authoritative one
+    return out
+
+
+def build_file_context(
+    path: pathlib.Path, rel: str, contracts: Contracts
+) -> tuple[FileContext | None, list[Violation]]:
+    """Parse one file. Returns (context, violations-so-far); a syntax
+    error yields (None, [PTA-syntax violation])."""
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as e:
+        return None, [Violation(
+            code="PTA000", rule="parse-error", path=rel,
+            line=e.lineno or 1, col=e.offset or 0,
+            message=f"file does not parse: {e.msg}",
+        )]
+    comments = _scan_comments(source)
+    suppressions: dict[int, set[str]] = {}
+    violations: list[Violation] = []
+    background_lines: set[int] = set()
+    for line, text in comments.items():
+        if BACKGROUND_MARKER in text:
+            background_lines.add(line)
+        m = _NOQA_RE.search(text)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group("codes").split(",")}
+        if not m.group("reason"):
+            violations.append(Violation(
+                code="PTA000", rule="suppression-hygiene", path=rel,
+                line=line, col=0,
+                message=(
+                    "suppression without a reason: write "
+                    f"'# noqa: {','.join(sorted(codes))} -- <why this "
+                    "is sanctioned>'"
+                ),
+            ))
+            continue  # a reasonless suppression suppresses nothing
+        suppressions.setdefault(line, set()).update(codes)
+    ctx = FileContext(
+        path=rel, source=source, tree=tree, comments=comments,
+        suppressions=suppressions, background_lines=background_lines,
+        contracts=contracts,
+    )
+    return ctx, violations
+
+
+def _apply_suppressions(
+    violations: list[Violation], ctx: FileContext
+) -> list[Violation]:
+    out = []
+    for v in violations:
+        if v.code in ctx.suppressions.get(v.line, ()):
+            continue
+        out.append(v)
+    return out
+
+
+# ---- driving -----------------------------------------------------------
+
+
+def default_targets(root: pathlib.Path) -> list[pathlib.Path]:
+    """The shipped tree: the package, the bench harness, scripts/.
+    Tests are not scanned — they deliberately contain seeded-violation
+    snippets (as data) and drive private APIs the contracts exempt."""
+    out: list[pathlib.Path] = []
+    for base in ("poseidon_tpu", "scripts"):
+        d = root / base
+        if d.is_dir():
+            out.extend(
+                p for p in sorted(d.rglob("*.py"))
+                if not any(part in _SKIP_DIRS for part in p.parts)
+            )
+    for single in ("bench.py",):
+        p = root / single
+        if p.is_file():
+            out.append(p)
+    return out
+
+
+def _ensure_rules_loaded() -> None:
+    """Rule registration is an import-time side effect of the rules
+    module; every public entry point must force it or it would run
+    with an empty registry and report anything as clean."""
+    import poseidon_tpu.analysis.rules  # noqa: F401 (registry side effect)
+
+
+def analyze_file(
+    path: pathlib.Path,
+    root: pathlib.Path,
+    contracts: Contracts = DEFAULT_CONTRACTS,
+) -> list[Violation]:
+    _ensure_rules_loaded()
+    rel = path.relative_to(root).as_posix()
+    ctx, violations = build_file_context(path, rel, contracts)
+    if ctx is None:
+        return violations
+    found: list[Violation] = []
+    for _code, _name, rule in FILE_RULES:
+        found.extend(rule(ctx))
+    return violations + _apply_suppressions(found, ctx)
+
+
+def analyze_tree(
+    root: pathlib.Path,
+    paths: list[pathlib.Path] | None = None,
+    contracts: Contracts = DEFAULT_CONTRACTS,
+) -> tuple[list[Violation], int]:
+    """Run every rule over ``paths`` (default: the shipped tree).
+    Returns (violations, files_scanned)."""
+    _ensure_rules_loaded()
+    root = root.resolve()
+    targets = paths if paths is not None else default_targets(root)
+    files: dict[str, FileContext] = {}
+    violations: list[Violation] = []
+    for path in targets:
+        rel = path.resolve().relative_to(root).as_posix()
+        ctx, pre = build_file_context(path, rel, contracts)
+        violations.extend(pre)
+        if ctx is None:
+            continue
+        files[rel] = ctx
+        found: list[Violation] = []
+        for _code, _name, rule in FILE_RULES:
+            found.extend(rule(ctx))
+        violations.extend(_apply_suppressions(found, ctx))
+    repo_ctx = RepoContext(root=root, files=files, contracts=contracts)
+    for _code, _name, rule in REPO_RULES:
+        found = rule(repo_ctx)
+        # repo-rule violations anchored in a scanned file honor that
+        # file's suppressions too
+        kept: list[Violation] = []
+        for v in found:
+            fctx = files.get(v.path)
+            if fctx is not None and v.code in fctx.suppressions.get(
+                v.line, ()
+            ):
+                continue
+            kept.append(v)
+        violations.extend(kept)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.code))
+    return violations, len(files)
+
+
+# ---- output ------------------------------------------------------------
+
+
+def format_human(violations: list[Violation], files_scanned: int) -> str:
+    lines = [
+        f"{v.path}:{v.line}:{v.col}: {v.code} [{v.rule}] {v.message}"
+        for v in violations
+    ]
+    lines.append(
+        f"{len(violations)} violation(s) in {files_scanned} file(s) scanned"
+        if violations
+        else f"clean: 0 violations in {files_scanned} file(s) scanned"
+    )
+    return "\n".join(lines)
+
+
+def format_json(violations: list[Violation], files_scanned: int) -> str:
+    return json.dumps(
+        {
+            "violations": [v.as_dict() for v in violations],
+            "count": len(violations),
+            "files_scanned": files_scanned,
+        },
+        indent=2,
+    )

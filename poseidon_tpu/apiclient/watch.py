@@ -147,7 +147,7 @@ class _WatchStream(threading.Thread):
 
     # ---- the reconnect loop ----
 
-    def run(self) -> None:
+    def run(self) -> None:  # pta: background-thread
         attempt = 0
         while not (self._halt.is_set() or self.gone.is_set()):
             try:
@@ -188,7 +188,7 @@ class _WatchStream(threading.Thread):
                 ))
                 attempt += 1
 
-    def _connect(self):
+    def _connect(self):  # pta: background-thread
         params = urllib.parse.urlencode({
             "watch": "true",
             "resourceVersion": str(self.rv),
@@ -207,11 +207,11 @@ class _WatchStream(threading.Thread):
                 ) from e
             raise
 
-    def _push_gone(self, reason: str) -> None:
+    def _push_gone(self, reason: str) -> None:  # pta: background-thread
         self.gone.set()
         self.queue.put(("GONE", reason))
 
-    def _consume(self, resp) -> bool:
+    def _consume(self, resp) -> bool:  # pta: background-thread
         """Decode one connection's stream; True = clean server close.
 
         http.client's chunked reader swallows an abrupt mid-stream cut

@@ -109,6 +109,7 @@ from poseidon_tpu.models.knowledge import (
     MachineSample,
     TaskSample,
 )
+from poseidon_tpu.guards import FetchTimeout
 from poseidon_tpu.ops.resident import InflightSolve, ResidentSolver
 from poseidon_tpu.ops.transport import topology_from_columns
 from poseidon_tpu.trace import TraceGenerator
@@ -161,6 +162,10 @@ class SchedulerStats:
     # stream reconnects (apiclient/watch.py; zero in poll mode)
     watch_resyncs: int = 0
     watch_reconnects: int = 0
+    # pipelined placement fetches that missed their
+    # --max_solver_runtime deadline since the previous round (each one
+    # abandoned its round loudly: FETCH_TIMEOUT trace event + this)
+    fetch_timeouts: int = 0
     cost: int = 0
     backend: str = ""
     # host time spent in observe_* (poll snapshot diff or watch event
@@ -263,6 +268,7 @@ class SchedulerBridge:
         ] = collections.deque(maxlen=100_000)
         self._evictions_this_round = 0
         self._bind_failures = 0
+        self._fetch_timeouts = 0
         # per-round accumulators surfaced in SchedulerStats: observe
         # host time and watch degradation counts since the last round
         self._observe_ms = 0.0
@@ -661,6 +667,8 @@ class SchedulerBridge:
         self._evictions_this_round = 0
         stats.bind_failures = self._bind_failures
         self._bind_failures = 0
+        stats.fetch_timeouts = self._fetch_timeouts
+        self._fetch_timeouts = 0
         stats.observe_ms = round(self._observe_ms, 3)
         self._observe_ms = 0.0
         stats.watch_resyncs = self._watch_resyncs
@@ -674,11 +682,12 @@ class SchedulerBridge:
         stats.pods_total = len(cluster.tasks)
         stats.pods_pending = len(pending)
         # rebalancing rounds run on running tasks alone — correcting a
-        # drifted packing needs no pending arrivals
-        has_rebal = self.enable_preemption and any(
-            t.phase == TaskPhase.RUNNING and t.machine in self.machines
-            for t in cluster.tasks
-        )
+        # drifted packing needs no pending arrivals. pod_to_machine
+        # holds exactly the RUNNING-on-a-known-machine set (every
+        # transition that breaks that pops the entry), so this is the
+        # O(1) form of the old O(cluster) any()-walk the contract
+        # linter flagged (PTA002).
+        has_rebal = self.enable_preemption and bool(self.pod_to_machine)
         if not self.machines or (not pending and not has_rebal):
             stats.total_ms = (time.perf_counter() - t_start) * 1000
             stats.wall_ms = stats.total_ms
@@ -772,7 +781,20 @@ class SchedulerBridge:
         t_fin = time.perf_counter()
         stats.overlap_ms = (t_fin - ir.t_begin_end) * 1000
 
-        outcome = self.solver.finish_round(ir.solve)
+        try:
+            outcome = self.solver.finish_round(ir.solve)
+        except FetchTimeout as e:
+            # the pipelined fetch missed its --max_solver_runtime
+            # deadline: degrade LOUDLY (trace event + counter surfaced
+            # in the NEXT round's stats, since this one is abandoned)
+            # and let the driver's round-failure path skip the tick
+            self._fetch_timeouts += 1
+            self.trace.emit(
+                "FETCH_TIMEOUT", round_num=ir.stats.round_num,
+                detail={"error": str(e)},
+            )
+            self.trace.flush()
+            raise
         meta = ir.meta
         # phase accounting: prep+upload feed the price column, the pure
         # device compute is the solve column, the result download the
@@ -930,8 +952,20 @@ class SchedulerBridge:
         if ir.solve is not None:
             # drain-only: certificate checks / oracle fallback would
             # block the error-recovery path (up to the full oracle
-            # timeout) for a result being thrown away
+            # timeout) for a result being thrown away. A fetch that
+            # misses its deadline here is still surfaced (counter +
+            # trace event) like a finish_round miss — discard_round
+            # swallows the exception, so diff its counter.
+            before = self.solver.fetch_timeouts
             self.solver.discard_round(ir.solve)
+            missed = self.solver.fetch_timeouts - before
+            if missed:
+                self._fetch_timeouts += missed
+                self.trace.emit(
+                    "FETCH_TIMEOUT", round_num=ir.stats.round_num,
+                    detail={"error": "fetch abandoned in cancel_round"},
+                )
+                self.trace.flush()
 
     @property
     def solver_timeout_s(self) -> float:

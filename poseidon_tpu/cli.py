@@ -129,8 +129,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_solver_runtime", type=int,
                    default=1_000_000_000,
                    help="microseconds; bounds one oracle-fallback solve "
-                        "(the TPU kernel is bounded by its round fuse; "
-                        "reference poseidon.cfg:14-15)")
+                        "AND the pipelined round's background placement "
+                        "fetch (a miss degrades loudly: FETCH_TIMEOUT "
+                        "trace event + stats counter, round abandoned; "
+                        "the TPU kernel itself is bounded by its round "
+                        "fuse; reference poseidon.cfg:14-15)")
     p.add_argument("--logtostderr", action="store_true")
     p.add_argument("--flagfile", default="",
                    help="gflags-style file of --name=value lines")

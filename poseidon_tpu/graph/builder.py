@@ -809,13 +809,13 @@ class IncrementalFlowGraphBuilder:
             ok = (
                 len(pending) == len(cols.uids)
                 and len(cluster.machines) == len(cols.machine_names)
-                and [t.uid for t in pending] == cols.uids.tolist()
+                and [t.uid for t in pending] == cols.uids.tolist()  # noqa: PTA002 -- deliberate O(T) self-heal verify: a missed churn event must degrade to a full rebuild, never a wrong graph (class docstring)
             )
             if ok and self.preemption:
                 # the running block is equally load-bearing in
                 # rebalancing mode: verify (uid, machine) pairs against
                 # the live cluster in canonical (uid-sorted) order
-                live = sorted(
+                live = sorted(  # noqa: PTA002 -- deliberate O(T) self-heal verify of the rebalancing running block (same contract as the pending check above)
                     (t.uid, t.machine) for t in cluster.tasks
                     if t.phase == TaskPhase.RUNNING
                     and t.machine in cols.midx

@@ -1,0 +1,118 @@
+"""Runtime enforcement of the contracts the static pass (analysis/)
+checks at review time.
+
+Three guards, all cheap enough to stay on in production:
+
+- ``no_implicit_transfers()``: ``jax.transfer_guard("disallow")`` as a
+  context manager. The resident round runs under it — any implicit
+  host sync (``float()`` on a device array, a numpy coercion of a
+  traced result, a stray dispatch on host operands) raises instead of
+  silently re-adding the ~100 ms per-sync charge PR 1 removed.
+  Explicit ``jax.device_put`` / ``jax.device_get`` stay permitted;
+  pairing with the PTA001 lint keeps those to the sanctioned sites.
+- ``sanctioned_transfer()``: ``jax.transfer_guard("allow")`` for the
+  round's one blessed fetch (and the degrade paths), making the
+  allow-list visible in the code instead of implied.
+- ``CompileCounter``: counts XLA backend compiles via
+  ``jax.monitoring`` so tests can assert the steady-state recompile
+  budget (zero) — a recompile regression fails tier-1, not just bench.
+
+``FetchTimeout`` is raised by the resident solver when the pipelined
+round's background placement fetch exceeds its deadline
+(``--max_solver_runtime``); the bridge turns it into a FETCH_TIMEOUT
+trace event + ``SchedulerStats.fetch_timeouts`` so the degradation is
+loud, then the driver skips the tick like any other failed round.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+try:  # jax >= 0.3.18; poseidon_tpu.compat covers older shims elsewhere
+    _transfer_guard = jax.transfer_guard
+except AttributeError:  # pragma: no cover - ancient jax
+    _transfer_guard = None
+
+
+class FetchTimeout(RuntimeError):
+    """The background placement fetch missed its deadline."""
+
+
+@contextlib.contextmanager
+def no_implicit_transfers():
+    """Disallow implicit device<->host transfers inside the block.
+
+    No-op when this jax has no transfer guard (the static PTA001 pass
+    still covers the contract there).
+    """
+    if _transfer_guard is None:  # pragma: no cover - ancient jax
+        yield
+        return
+    with _transfer_guard("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def sanctioned_transfer():
+    """Explicitly allow transfers: the round's blessed fetch sites."""
+    if _transfer_guard is None:  # pragma: no cover - ancient jax
+        yield
+        return
+    with _transfer_guard("allow"):
+        yield
+
+
+# ---- compile counting --------------------------------------------------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_counter_lock = threading.Lock()
+_active_counters: list["CompileCounter"] = []
+_listener_installed = False
+
+
+def _on_event(name: str, *_args, **_kw) -> None:
+    if name != _COMPILE_EVENT:
+        return
+    with _counter_lock:
+        for c in _active_counters:
+            c.count += 1
+
+
+def _install_listener() -> bool:
+    """Register the monitoring listener once per process. jax has no
+    unregister (only clear-all, which would drop other listeners), so
+    the hook stays installed and counters activate/deactivate."""
+    global _listener_installed
+    if _listener_installed:
+        return True
+    try:
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+    except AttributeError:  # pragma: no cover - jax without monitoring
+        return False
+    _listener_installed = True
+    return True
+
+
+class CompileCounter:
+    """Context manager counting XLA backend compiles in the block.
+
+    ``supported`` is False when this jax exposes no monitoring hook —
+    callers (the budget tests) skip rather than pass vacuously.
+    """
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.supported = False
+
+    def __enter__(self) -> "CompileCounter":
+        self.supported = _install_listener()
+        with _counter_lock:
+            _active_counters.append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with _counter_lock:
+            _active_counters.remove(self)

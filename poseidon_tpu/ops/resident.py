@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 from functools import partial
 
@@ -43,6 +44,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from poseidon_tpu.compat import enable_x64
+from poseidon_tpu.guards import (
+    FetchTimeout,
+    no_implicit_transfers,
+    sanctioned_transfer,
+)
 from poseidon_tpu.graph.builder import GraphMeta
 from poseidon_tpu.graph.network import FlowNetwork, pad_bucket
 from poseidon_tpu.models import get_cost_model
@@ -250,9 +256,53 @@ def _jitted_model(name: str):
     fn = get_cost_model(name)
     jitted = _MODEL_JIT_CACHE.get(fn)
     if jitted is None:
-        jitted = jax.jit(fn)
+        jitted = jax.jit(fn)  # noqa: PTA003 -- cached in _MODEL_JIT_CACHE keyed by fn: one wrapper per model for the process lifetime, not per call
         _MODEL_JIT_CACHE[fn] = jitted
     return jitted
+
+
+class _AsyncFetch:
+    """Single-shot background download with a bounded join.
+
+    Replaces the previous shared ThreadPoolExecutor: the worker is a
+    daemon thread, so a fetch wedged on a dead device link can neither
+    block interpreter exit nor poison a shared pool for the next round
+    — a timed-out fetch is simply abandoned (one parked daemon thread,
+    loudly logged by the caller). The ``_done`` Event set/wait pair is
+    the documented cross-thread handoff (analysis/contracts.py,
+    PTA004): ``_value``/``_exc`` are written before ``set()`` and read
+    only after ``wait()`` returns.
+    """
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._done = threading.Event()
+        self._value = None
+        self._exc: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, name="resident-fetch", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):  # pta: background-thread
+        try:
+            self._value = self._fn()
+        except BaseException as e:  # delivered to the joining thread
+            self._exc = e
+        finally:
+            self._done.set()
+
+    def result(self, timeout_s: float | None = None):
+        """Join the fetch; raises ``FetchTimeout`` past the deadline
+        (the fetch keeps running — the caller decides to abandon)."""
+        if not self._done.wait(timeout_s):
+            raise FetchTimeout(
+                f"background placement fetch still pending after "
+                f"{timeout_s:g}s (--max_solver_runtime)"
+            )
+        if self._exc is not None:
+            raise self._exc
+        return self._value
 
 
 @partial(
@@ -391,11 +441,18 @@ class ResidentSolver:
         oracle_fallback: bool = True,
         oracle_timeout_s: float = 1000.0,
         small_to_oracle: bool = True,
+        fetch_timeout_s: float | None = None,
     ):
         self.alpha = alpha
         self.max_rounds = max_rounds
         self.oracle_fallback = oracle_fallback
         self.oracle_timeout_s = oracle_timeout_s
+        # deadline on the background placement fetch (the pipelined
+        # path's analog of --max_solver_runtime, which previously only
+        # bounded the oracle subprocess); None = same budget as the
+        # oracle. A miss raises FetchTimeout: counted, traced by the
+        # bridge, and the round abandoned — never a silent forever-wait
+        self.fetch_timeout_s = fetch_timeout_s
         # dispatch heuristic: tiny instances go straight to the oracle
         # (the TPU per-launch floor exceeds the whole subprocess solve
         # there — solver.SMALL_INSTANCE_* documents the measurement)
@@ -405,9 +462,14 @@ class ResidentSolver:
         self._e_floor = 16
         self._t_floor = 16
         self._m_floor = 16
-        # async placement fetch (one round in flight at a time)
-        self._fetch_pool = None
+        # one round in flight at a time
         self._inflight = False
+        # observability: lifetime fetch-deadline misses, and how many
+        # sanctioned downloads the LAST round performed (1 on the
+        # certified dense path — the "exactly one host sync" contract,
+        # asserted by tests/test_guards.py)
+        self.fetch_timeouts = 0
+        self.last_round_fetches = 0
 
     def reset(self) -> None:
         self._warm = None
@@ -417,14 +479,11 @@ class ResidentSolver:
         """The on-HBM warm handle carried across rounds (None = cold)."""
         return self._warm
 
-    def _get_fetch_pool(self):
-        if self._fetch_pool is None:
-            import concurrent.futures
-
-            self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="resident-fetch"
-            )
-        return self._fetch_pool
+    def _fetch_deadline_s(self) -> float:
+        return (
+            self.fetch_timeout_s if self.fetch_timeout_s is not None
+            else self.oracle_timeout_s
+        )
 
     def run_round(
         self,
@@ -473,6 +532,7 @@ class ResidentSolver:
                 "a resident round is already in flight; finish_round() "
                 "must be called before the next begin_round()"
             )
+        self.last_round_fetches = 0
         timings: dict[str, float] = {}
         t0 = time.perf_counter()
         # grow-only bucket floors: arc/task counts oscillating across a
@@ -577,10 +637,12 @@ class ResidentSolver:
         # while the caller does next-round host work; ``solve_ms``
         # covers dispatch + execution + completion regardless of where
         # the caller was when it completed.
-        t0 = time.perf_counter()
-        inputs_dev, dt = jax.device_put((inputs_host, dt_host))
-        timings["upload_ms"] = (time.perf_counter() - t0) * 1000
-
+        #
+        # The block runs under jax.transfer_guard("disallow"): the one
+        # upload is an EXPLICIT device_put (permitted), the one
+        # download an explicit sanctioned device_get on the fetch
+        # thread — any other host sync slipping into this window
+        # raises instead of silently re-adding a per-round sync.
         warm = self._warm
         if warm is not None and (
             warm.asg.shape[0] != Tp or warm.floor.shape[0] != Mp
@@ -591,36 +653,48 @@ class ResidentSolver:
             else default_fuse()
         )
         model_fn = get_cost_model(cost_model)
+        # argument prep OUTSIDE the guard: jnp.zeros eagerly uploads
+        # its fill scalar (an implicit h2d the guard would reject);
+        # shapes are bucketed so these hit jax's cache in steady state
         zeros_t = jnp.zeros(Tp, I32)
         zeros_m = jnp.zeros(Mp, I32)
 
-        t_dispatch = time.perf_counter()
-        with enable_x64(True):
-            (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d, phases_d,
-             ch_dev, primal, domain_ok, cost_dev) = _resident_chain(
-                dt, inputs_dev,
-                warm.asg if warm is not None else zeros_t,
-                warm.lvl if warm is not None else zeros_t,
-                warm.floor if warm is not None else zeros_m,
-                model_fn=model_fn, n_prefs=P, smax=smax,
-                alpha=self.alpha, max_rounds=max_rounds,
-                warm_start=warm is not None,
+        t0 = time.perf_counter()
+        with no_implicit_transfers():
+            inputs_dev, dt = jax.device_put((inputs_host, dt_host))
+            timings["upload_ms"] = (time.perf_counter() - t0) * 1000
+
+            t_dispatch = time.perf_counter()
+            with enable_x64(True):
+                (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
+                 phases_d, ch_dev, primal, domain_ok, cost_dev) = (
+                    _resident_chain(
+                        dt, inputs_dev,
+                        warm.asg if warm is not None else zeros_t,
+                        warm.lvl if warm is not None else zeros_t,
+                        warm.floor if warm is not None else zeros_m,
+                        model_fn=model_fn, n_prefs=P, smax=smax,
+                        alpha=self.alpha, max_rounds=max_rounds,
+                        warm_start=warm is not None,
+                    )
+                )
+            state = DenseState(
+                asg=asg_d, lvl=lvl_d, floor=floor_d, gap=gap_d,
+                converged=conv_d, rounds=rounds_d, phases=phases_d,
             )
-        state = DenseState(
-            asg=asg_d, lvl=lvl_d, floor=floor_d, gap=gap_d,
-            converged=conv_d, rounds=rounds_d, phases=phases_d,
-        )
 
         def _fetch():
-            vals = jax.device_get((
-                state.asg, ch_dev, state.converged, state.rounds,
-                state.phases, primal, domain_ok,
-            ))
+            with sanctioned_transfer():
+                vals = jax.device_get((  # noqa: PTA001 -- THE round's one sanctioned placement fetch (module docstring)
+                    state.asg, ch_dev, state.converged, state.rounds,
+                    state.phases, primal, domain_ok,
+                ))
             return vals, time.perf_counter()
 
         self._inflight = True
+        self.last_round_fetches = 1
         return InflightSolve(
-            future=self._get_fetch_pool().submit(_fetch),
+            future=_AsyncFetch(_fetch),
             state=state,
             cost_dev=cost_dev,
             arrays=arrays,
@@ -655,7 +729,15 @@ class ResidentSolver:
         self._inflight = False
         inflight.consumed = True
         try:
-            inflight.future.result()
+            inflight.future.result(timeout_s=self._fetch_deadline_s())
+        except FetchTimeout:
+            # the worker is a daemon thread on an abandoned handle:
+            # leak it loudly rather than block the recovery path
+            self.fetch_timeouts += 1
+            log.error(
+                "discard_round: abandoning a placement fetch still "
+                "pending after %gs", self._fetch_deadline_s(),
+            )
         except Exception:
             log.exception("discard_round: in-flight fetch failed")
 
@@ -670,9 +752,25 @@ class ResidentSolver:
         topo = inflight.topo
         T = inflight.T
         t0 = time.perf_counter()
-        (asg_np, ch_np, conv, rounds, phases, primal_np, dom_ok), t_done = (
-            inflight.future.result()
-        )
+        try:
+            (asg_np, ch_np, conv, rounds, phases, primal_np, dom_ok), \
+                t_done = inflight.future.result(
+                    timeout_s=self._fetch_deadline_s()
+                )
+        except FetchTimeout:
+            # degrade LOUDLY instead of blocking the round forever:
+            # count it, drop the warm handle (device health unknown),
+            # and re-raise — the bridge traces FETCH_TIMEOUT and the
+            # driver skips the tick. The daemon fetch thread is
+            # abandoned with its handle.
+            self.fetch_timeouts += 1
+            self._warm = None
+            log.error(
+                "placement fetch missed its %gs deadline "
+                "(--max_solver_runtime); abandoning the round",
+                self._fetch_deadline_s(),
+            )
+            raise
         # fetch_wait is the part of the sync the caller actually blocked
         # on; the rest elapsed under overlapped host work
         timings["fetch_wait_ms"] = (time.perf_counter() - t0) * 1000
@@ -692,32 +790,39 @@ class ResidentSolver:
             # columns — this round really does pay twice). Synchronous:
             # the overlap window is gone by the time we know.
             self._warm = None
+            t0 = time.perf_counter()
+            # zeros outside the guard: their fill-scalar upload is an
+            # implicit h2d (see begin_round)
             zeros_t = jnp.zeros(inflight.Tp, I32)
             zeros_m = jnp.zeros(inflight.Mp, I32)
-            t0 = time.perf_counter()
-            with enable_x64(True):
-                (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
-                 phases_d, ch_dev, primal, _dom, cost_dev) = (
-                    _resident_chain(
-                        inflight.dt, inflight.inputs_dev, zeros_t,
-                        zeros_t, zeros_m,
-                        model_fn=inflight.model_fn,
-                        n_prefs=inflight.n_prefs, smax=inflight.smax,
-                        alpha=self.alpha, max_rounds=inflight.max_rounds,
-                        warm_start=False,
+            with no_implicit_transfers():
+                with enable_x64(True):
+                    (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
+                     phases_d, ch_dev, primal, _dom, cost_dev) = (
+                        _resident_chain(
+                            inflight.dt, inflight.inputs_dev, zeros_t,
+                            zeros_t, zeros_m,
+                            model_fn=inflight.model_fn,
+                            n_prefs=inflight.n_prefs,
+                            smax=inflight.smax,
+                            alpha=self.alpha,
+                            max_rounds=inflight.max_rounds,
+                            warm_start=False,
+                        )
                     )
+                state = DenseState(
+                    asg=asg_d, lvl=lvl_d, floor=floor_d, gap=gap_d,
+                    converged=conv_d, rounds=rounds_d, phases=phases_d,
                 )
-            state = DenseState(
-                asg=asg_d, lvl=lvl_d, floor=floor_d, gap=gap_d,
-                converged=conv_d, rounds=rounds_d, phases=phases_d,
-            )
             inflight.cost_dev = cost_dev
-            asg_np, ch_np, conv, rounds, phases, primal_np = (
-                jax.device_get((
-                    state.asg, ch_dev, state.converged, state.rounds,
-                    state.phases, primal,
-                ))
-            )
+            self.last_round_fetches += 1
+            with sanctioned_transfer():
+                asg_np, ch_np, conv, rounds, phases, primal_np = (
+                    jax.device_get((  # noqa: PTA001 -- sanctioned second fetch of the cold retry (this round really does pay twice)
+                        state.asg, ch_dev, state.converged, state.rounds,
+                        state.phases, primal,
+                    ))
+                )
             timings["solve_ms"] += (time.perf_counter() - t0) * 1000
         if not bool(conv):
             self._warm = None
@@ -728,14 +833,14 @@ class ResidentSolver:
 
         self._warm = state
         Mp = inflight.Mp
-        asg = np.asarray(asg_np[:T], np.int32)
+        asg = np.asarray(asg_np[:T], np.int32)  # noqa: PTA001 -- asg_np is already-fetched HOST data (the sanctioned fetch above)
         asg = np.where(
             (asg >= 0) & (asg < Mp) & (asg < inflight.n_machines),
             asg, -1,
         ).astype(np.int32)
         return ResidentOutcome(
             assignment=asg,
-            channel=np.asarray(ch_np[:T], np.int32),
+            channel=np.asarray(ch_np[:T], np.int32),  # noqa: PTA001 -- already-fetched host data
             cost=int(primal_np) // (T + 1),
             backend="dense_auction",
             converged=True,
@@ -764,9 +869,10 @@ class ResidentSolver:
         from poseidon_tpu.oracle import solve_oracle
 
         t0 = time.perf_counter()
-        cost_host = np.asarray(
-            jax.device_get(cost_dev), np.int32
-        )[: meta.n_arcs]
+        self.last_round_fetches += 1
+        with sanctioned_transfer():
+            fetched = jax.device_get(cost_dev)  # noqa: PTA001 -- sanctioned degrade-path download of the priced arc table for the oracle
+        cost_host = np.asarray(fetched, np.int32)[: meta.n_arcs]  # noqa: PTA001 -- already-fetched host data
         net = FlowNetwork.from_arrays(
             arrays["src"], arrays["dst"], arrays["cap"], cost_host,
             arrays["supply"],
@@ -775,7 +881,7 @@ class ResidentSolver:
             net, algorithm="cost_scaling", timeout_s=self.oracle_timeout_s
         )
         placements = extract_placements(
-            np.asarray(o.flows, np.int64), meta,
+            np.asarray(o.flows, np.int64), meta,  # noqa: PTA001 -- oracle output is host data
             arrays["src"], arrays["dst"],
         )
         T = len(meta.task_uids)

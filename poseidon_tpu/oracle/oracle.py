@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import pathlib
 import subprocess
 
@@ -23,6 +24,9 @@ log = logging.getLogger(__name__)
 
 _ORACLE_DIR = pathlib.Path(__file__).resolve().parent
 _BINARY = _ORACLE_DIR / "build" / "mcmf_oracle"
+# CI points this at a sanitized build (build-asan/ or build-tsan/, see
+# the Makefile) so the SAME test suite exercises the hardened binaries
+_BINARY_OVERRIDE_ENV = "POSEIDON_TPU_ORACLE_BINARY"
 
 
 class OracleInfeasible(RuntimeError):
@@ -38,6 +42,15 @@ class OracleResult:
 
 
 def _ensure_built() -> pathlib.Path:
+    override = os.environ.get(_BINARY_OVERRIDE_ENV)
+    if override:
+        path = pathlib.Path(override)
+        if not path.exists():
+            raise RuntimeError(
+                f"{_BINARY_OVERRIDE_ENV}={override} does not exist "
+                f"(build it with: make -C {_ORACLE_DIR} SANITIZE=...)"
+            )
+        return path
     src = _ORACLE_DIR / "mcmf_oracle.cc"
     if not _BINARY.exists() or _BINARY.stat().st_mtime < src.stat().st_mtime:
         proc = subprocess.run(

@@ -11,8 +11,10 @@ SCHEDULE (placement decision), MIGRATE (rebalancing move, ``detail.
 from`` names the old machine), PREEMPT (rebalancing park), EVICT (node
 loss), FINISH (pod retired), WATCH_RESYNC (the watch subsystem degraded
 to a full LIST resync — ``detail.reason`` names why: 410 Gone, decode
-error, or staleness) and WATCH_RECONNECT (an error-path watch-stream
-reconnect, ``detail.resource``/``detail.reason``),
+error, or staleness), WATCH_RECONNECT (an error-path watch-stream
+reconnect, ``detail.resource``/``detail.reason``) and FETCH_TIMEOUT
+(the pipelined round's background placement fetch missed its
+``--max_solver_runtime`` deadline; the round is abandoned loudly),
 plus ROUND records carrying the per-phase timing/stat payload
 (``SchedulerStats`` as a dict — including the round-pipeline timers:
 ``build_mode`` delta/full/legacy, ``dispatch_ms``, ``fetch_wait_ms``,
@@ -33,13 +35,30 @@ import json
 import time
 from typing import Callable, IO
 
+# The DECLARED event vocabulary. Consumers key on these names, so an
+# emit outside the set is a silent contract break for every downstream
+# trace reader: the static pass (analysis/rules.py PTA005) checks every
+# ``*.emit("NAME")`` call site against this set, and ``emit`` enforces
+# it at runtime. Extending the vocabulary = adding the name here (and
+# documenting it in the module docstring above).
+EVENT_TYPES = frozenset({
+    "SUBMIT",           # pod observed
+    "SCHEDULE",         # placement decision
+    "MIGRATE",          # rebalancing move
+    "PREEMPT",          # rebalancing park
+    "EVICT",            # node loss
+    "FINISH",           # pod retired
+    "ROUND",            # per-round stats payload
+    "WATCH_RESYNC",     # watch degraded to a full LIST resync
+    "WATCH_RECONNECT",  # error-path watch-stream reconnect
+    "FETCH_TIMEOUT",    # pipelined placement fetch missed its deadline
+})
+
 
 @dataclasses.dataclass
 class TraceEvent:
     timestamp_us: int
-    event: str              # SUBMIT | SCHEDULE | MIGRATE | PREEMPT |
-                            # EVICT | FINISH | ROUND | WATCH_RESYNC |
-                            # WATCH_RECONNECT
+    event: str              # one of EVENT_TYPES
     task: str = ""
     machine: str = ""
     round_num: int = 0
@@ -72,6 +91,11 @@ class TraceGenerator:
         round_num: int = 0,
         detail: dict | None = None,
     ) -> None:
+        if event not in EVENT_TYPES:
+            raise ValueError(
+                f"undeclared trace event {event!r}; the vocabulary is "
+                f"trace.EVENT_TYPES (PTA005)"
+            )
         ev = TraceEvent(
             timestamp_us=self.clock_us(),
             event=event,

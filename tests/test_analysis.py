@@ -1,0 +1,437 @@
+"""Tests for the contract linter (poseidon_tpu/analysis).
+
+Per-rule known-bad/known-good snippet pairs, the suppression contract
+(a reason is mandatory), the self-check (the shipped tree is
+violation-free), and the acceptance injections: seeding a ``.item()``
+into the real ``ops/resident.py`` or an unlocked cross-thread mutation
+into the real ``bridge/bridge.py`` must make the analyzer (and so CI)
+fail.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+from poseidon_tpu.analysis import DEFAULT_CONTRACTS, analyze_tree
+from poseidon_tpu.analysis.contracts import Contracts, ThreadContract
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run_on(tmp_path, files, contracts=DEFAULT_CONTRACTS):
+    """Write a snippet tree under tmp_path and analyze it."""
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        if rel.endswith(".py"):
+            paths.append(p)
+    violations, _ = analyze_tree(tmp_path, paths, contracts)
+    return violations
+
+
+def codes(violations):
+    return [v.code for v in violations]
+
+
+class TestPTA001HostSync:
+    # the suffix puts the snippet in a declared whole-file hot scope
+    HOT = "poseidon_tpu/ops/resident.py"
+
+    def test_bad_syncs_flagged(self, tmp_path):
+        vs = run_on(tmp_path, {self.HOT: """\
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def round_step(x):
+                v = x.item()
+                h = np.asarray(x)
+                g = jax.device_get(x)
+                x.block_until_ready()
+                cost = jnp.add(x, 1)
+                s = int(cost)
+                return v, h, g, s
+        """})
+        assert codes(vs) == ["PTA001"] * 5
+
+    def test_good_host_code_clean(self, tmp_path):
+        vs = run_on(tmp_path, {self.HOT: """\
+            import numpy as np
+
+            def round_step(asg_np, T):
+                # int()/np ops on host data do not sync
+                asg = np.where(asg_np >= 0, asg_np, -1)
+                return int(T), asg
+        """})
+        assert vs == []
+
+    def test_device_get_is_a_taint_barrier(self, tmp_path):
+        vs = run_on(tmp_path, {self.HOT: """\
+            import jax
+            import jax.numpy as jnp
+
+            def round_step(x):
+                cost = jnp.add(x, 1)
+                host = jax.device_get(cost)  # noqa: PTA001 -- test fixture: the sanctioned fetch
+                return int(host)             # host data: no second sync
+        """})
+        assert vs == []
+
+    def test_out_of_scope_file_not_checked(self, tmp_path):
+        vs = run_on(tmp_path, {"poseidon_tpu/somewhere_else.py": """\
+            def f(x):
+                return x.item()
+        """})
+        assert vs == []
+
+
+class TestPTA002ClusterLoops:
+    BRIDGE = "poseidon_tpu/bridge/bridge.py"
+
+    def test_loop_in_scope_flagged(self, tmp_path):
+        vs = run_on(tmp_path, {self.BRIDGE: """\
+            class SchedulerBridge:
+                def begin_round(self):
+                    n = 0
+                    for t in self.tasks:
+                        n += 1
+                    return n
+        """})
+        assert codes(vs) == ["PTA002"]
+
+    def test_genexp_over_cluster_flagged(self, tmp_path):
+        vs = run_on(tmp_path, {self.BRIDGE: """\
+            class SchedulerBridge:
+                def begin_round(self, cluster):
+                    return any(t.live for t in cluster.tasks)
+        """})
+        assert codes(vs) == ["PTA002"]
+
+    def test_churn_loop_and_out_of_scope_clean(self, tmp_path):
+        vs = run_on(tmp_path, {self.BRIDGE: """\
+            class SchedulerBridge:
+                def begin_round(self, dset):
+                    for d in dset.place:   # O(churn): this round's deltas
+                        self.apply(d)
+
+                def observe_nodes(self, nodes):
+                    for n in nodes:        # the poll path is O(cluster) by design
+                        self.upsert(n)
+        """})
+        assert vs == []
+
+
+class TestPTA003JitHygiene:
+    def test_inline_jit_flagged(self, tmp_path):
+        vs = run_on(tmp_path, {"poseidon_tpu/x.py": """\
+            import jax
+
+            def price(model, x):
+                return jax.jit(model)(x)
+        """})
+        assert codes(vs) == ["PTA003"]
+
+    def test_mutable_static_default_and_unknown_name(self, tmp_path):
+        vs = run_on(tmp_path, {"poseidon_tpu/x.py": """\
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("opts", "zzz"))
+            def f(x, opts=[]):
+                return x
+        """})
+        assert sorted(codes(vs)) == ["PTA003", "PTA003"]
+        msgs = " | ".join(v.message for v in vs)
+        assert "mutable default" in msgs and "'zzz'" in msgs
+
+    def test_nested_jit_closure_capture(self, tmp_path):
+        vs = run_on(tmp_path, {"poseidon_tpu/x.py": """\
+            import jax
+
+            def outer(k):
+                @jax.jit
+                def inner(x):
+                    return x + k
+                return inner
+        """})
+        msgs = " | ".join(v.message for v in vs)
+        assert codes(vs) == ["PTA003", "PTA003"]
+        assert "defined inside a function" in msgs
+        assert "closes over 'k'" in msgs
+
+    def test_module_level_jit_clean(self, tmp_path):
+        vs = run_on(tmp_path, {"poseidon_tpu/x.py": """\
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("n",))
+            def f(x, n=4):
+                return x * n
+
+            _g = jax.jit(lambda x: x + 1)
+        """})
+        assert vs == []
+
+
+class TestPTA004LockDiscipline:
+    # SchedulerBridge is a declared thread class in the default contracts
+    ANY = "poseidon_tpu/bridge/bridge.py"
+
+    BAD = """\
+        class SchedulerBridge:
+            def __init__(self):
+                self.round_num = 0
+
+            def bump(self):
+                self.round_num += 1
+
+            def poll(self):  # pta: background-thread
+                self.round_num += 1
+    """
+
+    def test_unlocked_cross_thread_write_flagged(self, tmp_path):
+        vs = run_on(tmp_path, {self.ANY: self.BAD})
+        assert set(codes(vs)) == {"PTA004"}
+        assert len(vs) == 2  # both unlocked sites (main + background)
+
+    def test_locked_sites_clean(self, tmp_path):
+        vs = run_on(tmp_path, {self.ANY: """\
+            class SchedulerBridge:
+                def __init__(self):
+                    self.round_num = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.round_num += 1
+
+                def poll(self):  # pta: background-thread
+                    with self._lock:
+                        self.round_num += 1
+        """})
+        assert vs == []
+
+    def test_declared_handoff_clean(self, tmp_path):
+        contracts = Contracts(
+            thread_classes={
+                "SchedulerBridge": ThreadContract(
+                    handoffs={"round_num": "test: monotonic counter"}
+                ),
+            },
+        )
+        vs = run_on(tmp_path, {self.ANY: self.BAD}, contracts)
+        assert vs == []
+
+    def test_single_thread_class_clean(self, tmp_path):
+        vs = run_on(tmp_path, {self.ANY: """\
+            class SchedulerBridge:
+                def __init__(self):
+                    self.round_num = 0
+
+                def bump(self):
+                    self.round_num += 1   # main thread only: fine
+        """})
+        assert vs == []
+
+
+class TestPTA005Surface:
+    def test_undeclared_and_dynamic_event_flagged(self, tmp_path):
+        vs = run_on(tmp_path, {
+            "poseidon_tpu/trace.py": """\
+                EVENT_TYPES = frozenset({"ROUND", "SCHEDULE"})
+            """,
+            "poseidon_tpu/other.py": """\
+                class T:
+                    def go(self, name):
+                        self.trace.emit("ROUND")
+                        self.trace.emit("BOGUS")
+                        self.trace.emit(name)
+            """,
+        })
+        assert codes(vs) == ["PTA005", "PTA005"]
+        msgs = " | ".join(v.message for v in vs)
+        assert "BOGUS" in msgs and "dynamic" in msgs
+
+    def test_missing_vocab_flagged(self, tmp_path):
+        vs = run_on(tmp_path, {"poseidon_tpu/trace.py": """\
+            def emit(x):
+                pass
+        """})
+        assert codes(vs) == ["PTA005"]
+
+    def test_undocumented_flag_flagged(self, tmp_path):
+        files = {
+            "poseidon_tpu/cli.py": """\
+                import argparse
+
+                def build_parser():
+                    p = argparse.ArgumentParser()
+                    p.add_argument("--alpha", type=int)
+                    p.add_argument("--hidden", help=argparse.SUPPRESS)
+                    return p
+            """,
+            "README.md": "docs mention --alpha here\n",
+            "deploy/poseidon-tpu.cfg": "# no flags here\n",
+        }
+        vs = run_on(tmp_path, files)
+        assert codes(vs) == ["PTA005"]
+        assert "--alpha" in vs[0].message
+        assert "deploy/poseidon-tpu.cfg" in vs[0].message
+        # hidden (SUPPRESS) flags are exempt; documenting --alpha fixes it
+        files["deploy/poseidon-tpu.cfg"] = "--alpha=3\n"
+        assert run_on(tmp_path, files) == []
+
+    def test_flag_name_prefix_does_not_count(self, tmp_path):
+        # "--watch_max_lag" in a doc must NOT satisfy "--watch"
+        vs = run_on(tmp_path, {
+            "poseidon_tpu/cli.py": """\
+                import argparse
+
+                def build_parser():
+                    p = argparse.ArgumentParser()
+                    p.add_argument("--watch")
+                    return p
+            """,
+            "README.md": "only --watch_max_lag is named\n",
+            "deploy/poseidon-tpu.cfg": "--watch=false\n",
+        })
+        assert codes(vs) == ["PTA005"]
+        assert "README.md" in vs[0].message
+
+
+class TestSuppressions:
+    HOT = "poseidon_tpu/ops/resident.py"
+
+    def test_suppression_without_reason_fails(self, tmp_path):
+        vs = run_on(tmp_path, {self.HOT: """\
+            def f(x):
+                return x.item()  # noqa: PTA001
+        """})
+        # the bare suppression is PTA000 AND suppresses nothing
+        assert codes(vs) == ["PTA000", "PTA001"]
+
+    def test_suppression_with_reason_suppresses(self, tmp_path):
+        vs = run_on(tmp_path, {self.HOT: """\
+            def f(x):
+                return x.item()  # noqa: PTA001 -- test fixture: sanctioned
+        """})
+        assert vs == []
+
+    def test_suppression_only_covers_named_code(self, tmp_path):
+        vs = run_on(tmp_path, {self.HOT: """\
+            def f(x):
+                return x.item()  # noqa: PTA002 -- wrong code named
+        """})
+        assert codes(vs) == ["PTA001"]
+
+
+class TestSelfCheck:
+    def test_shipped_tree_is_violation_free(self):
+        violations, files_scanned = analyze_tree(REPO)
+        assert files_scanned > 30
+        assert violations == [], "\n".join(
+            f"{v.path}:{v.line} {v.code} {v.message}" for v in violations
+        )
+
+    def test_injected_item_in_resident_fused_round_fails(self, tmp_path):
+        """Acceptance: a stray .item() in the resident round fails CI."""
+        src = (REPO / "poseidon_tpu/ops/resident.py").read_text()
+        anchor = "        self._warm = state"
+        assert anchor in src
+        bad = src.replace(
+            anchor, "        leak = primal.item()\n" + anchor, 1
+        )
+        vs = run_on(tmp_path, {"poseidon_tpu/ops/resident.py": bad})
+        assert any(
+            v.code == "PTA001" and ".item()" in v.message for v in vs
+        )
+
+    def test_injected_unlocked_mutation_in_bridge_fails(self, tmp_path):
+        """Acceptance: an unlocked cross-thread mutation in the bridge
+        fails CI."""
+        src = (REPO / "poseidon_tpu/bridge/bridge.py").read_text()
+        anchor = "    def cancel_round("
+        assert anchor in src
+        bad = src.replace(anchor, (
+            "    def _bg_refresh(self):  # pta: background-thread\n"
+            "        self.round_num += 1\n\n"
+        ) + anchor, 1)
+        vs = run_on(tmp_path, {"poseidon_tpu/bridge/bridge.py": bad})
+        assert any(
+            v.code == "PTA004" and "round_num" in v.message for v in vs
+        )
+
+    def test_unmodified_copies_stay_clean(self, tmp_path):
+        """The injection tests prove the analyzer reacts to the SEED,
+        not to analyzing a file in isolation."""
+        vs = run_on(tmp_path, {
+            "poseidon_tpu/ops/resident.py":
+                (REPO / "poseidon_tpu/ops/resident.py").read_text(),
+            "poseidon_tpu/bridge/bridge.py":
+                (REPO / "poseidon_tpu/bridge/bridge.py").read_text(),
+        })
+        assert vs == []
+
+
+class TestCli:
+    def test_json_output_clean_exit_zero(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "poseidon_tpu.analysis",
+             "--format=json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["count"] == 0
+        assert doc["violations"] == []
+        assert doc["files_scanned"] > 30
+
+    def test_analyze_file_api_in_fresh_interpreter(self, tmp_path):
+        """Regression: the public analyze_file must load the rule
+        registry itself — a fresh interpreter using only analyze_file
+        must not report a violating file as clean."""
+        bad = tmp_path / "poseidon_tpu" / "ops" / "resident.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("def f(x):\n    return x.item()\n")
+        proc = subprocess.run(
+            [sys.executable, "-c", (
+                "import pathlib, sys\n"
+                "from poseidon_tpu.analysis import analyze_file\n"
+                f"vs = analyze_file(pathlib.Path({str(bad)!r}), "
+                f"pathlib.Path({str(tmp_path)!r}))\n"
+                "assert [v.code for v in vs] == ['PTA001'], vs\n"
+                "print('ok')\n"
+            )],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_path_outside_root_exits_two(self, tmp_path):
+        stray = tmp_path / "stray.py"
+        stray.write_text("x = 1\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "poseidon_tpu.analysis",
+             "--root", str(REPO / "poseidon_tpu"), str(stray)],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "outside --root" in proc.stderr
+
+    def test_violations_exit_one(self, tmp_path):
+        bad = tmp_path / "poseidon_tpu" / "ops" / "resident.py"
+        bad.parent.mkdir(parents=True)
+        bad.write_text("def f(x):\n    return x.item()\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "poseidon_tpu.analysis",
+             "--format=json", "--root", str(tmp_path), str(bad)],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["count"] == 1
+        assert doc["violations"][0]["code"] == "PTA001"

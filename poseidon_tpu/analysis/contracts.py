@@ -96,6 +96,23 @@ DEFAULT_CONTRACTS = Contracts(
             "SchedulerBridge.begin_round",
             "SchedulerBridge.finish_round",
         ),
+        # the scale lane: aggregation planning/expansion runs inside
+        # the resident round (hot from day one — pure vectorized host
+        # numpy, no device syncs)
+        "poseidon_tpu/graph/aggregate.py": (
+            "plan_from_costs",
+            "plan_from_signatures",
+            "aggregate_topology",
+            "prune_topology_prefs",
+            "expand_assignment",
+            "_plan_from_keys",
+            "_pinned_mask",
+            "_float_bits",
+        ),
+        # the sharded-round layout helper: explicit device_put only
+        "poseidon_tpu/parallel/sharded.py": (
+            "resident_round_shardings",
+        ),
     },
     device_producers=(
         "jnp.",
@@ -125,6 +142,17 @@ DEFAULT_CONTRACTS = Contracts(
         "poseidon_tpu/ops/resident.py": (
             "ResidentSolver.begin_round",
             "ResidentSolver.finish_round",
+        ),
+        # aggregation planning/expansion must stay vectorized numpy:
+        # a Python walk over machines here is O(cluster) every round
+        "poseidon_tpu/graph/aggregate.py": (
+            "plan_from_costs",
+            "plan_from_signatures",
+            "aggregate_topology",
+            "prune_topology_prefs",
+            "expand_assignment",
+            "_plan_from_keys",
+            "_pinned_mask",
         ),
     },
     cluster_sized_names=(

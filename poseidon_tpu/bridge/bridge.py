@@ -166,6 +166,11 @@ class SchedulerStats:
     # --max_solver_runtime deadline since the previous round (each one
     # abandoned its round loudly: FETCH_TIMEOUT trace event + this)
     fetch_timeouts: int = 0
+    # lifetime count of dense-lane degrades to the CPU oracle
+    # (memory-envelope / cost-domain / uncertified — NOT the deliberate
+    # small-instance routing); each one also emits a DEGRADE trace
+    # event, so oversize rounds are observable, not just logged
+    degrades_total: int = 0
     cost: int = 0
     backend: str = ""
     # host time spent in observe_* (poll snapshot diff or watch event
@@ -230,6 +235,9 @@ class SchedulerBridge:
         enable_preemption: bool = False,
         migration_hysteresis: int = 20,
         max_migrations_per_round: int = 64,
+        mesh_width: int = 0,
+        aggregate_classes: bool = False,
+        topk_prefs: int = 0,
     ):
         self.cost_model = cost_model
         self.max_tasks_per_machine = max_tasks_per_machine
@@ -243,10 +251,16 @@ class SchedulerBridge:
         self.pod_to_machine: dict[str, str] = {}
         self.round_num = 0
         # device-resident solve chain; its warm DenseState lives on HBM
-        # across rounds (the reference's --run_incremental_scheduler seam)
+        # across rounds (the reference's --run_incremental_scheduler
+        # seam). The scale lane rides here too: mesh_width shards the
+        # round's task axis over a device mesh, aggregate_classes/
+        # topk_prefs shrink the machine/pref axes (graph/aggregate.py)
         self.solver = ResidentSolver(
             oracle_timeout_s=solver_timeout_s,
             small_to_oracle=small_to_oracle,
+            mesh_width=mesh_width,
+            aggregate_classes=aggregate_classes,
+            topk_prefs=topk_prefs,
         )
         # O(churn) graph maintenance: every state transition below is
         # mirrored as a note; begin_round patches instead of rebuilding
@@ -269,6 +283,7 @@ class SchedulerBridge:
         self._evictions_this_round = 0
         self._bind_failures = 0
         self._fetch_timeouts = 0
+        self._degrades_total = 0
         # per-round accumulators surfaced in SchedulerStats: observe
         # host time and watch degradation counts since the last round
         self._observe_ms = 0.0
@@ -669,6 +684,7 @@ class SchedulerBridge:
         self._bind_failures = 0
         stats.fetch_timeouts = self._fetch_timeouts
         self._fetch_timeouts = 0
+        stats.degrades_total = self._degrades_total
         stats.observe_ms = round(self._observe_ms, 3)
         self._observe_ms = 0.0
         stats.watch_resyncs = self._watch_resyncs
@@ -811,6 +827,19 @@ class SchedulerBridge:
         stats.fetch_wait_ms = outcome.timings.get("fetch_wait_ms", 0.0)
         stats.backend = outcome.backend
         stats.cost = outcome.cost
+        # oversize/uncertified degrades are OBSERVABLE, not just
+        # logged: a DEGRADE trace event + the lifetime counter in
+        # stats. Deliberate routing (small-instance, non-taxonomy
+        # graphs) is dispatch, not degradation, and stays uncounted.
+        if outcome.backend.startswith("oracle:"):
+            why = outcome.backend.split(":", 1)[1]
+            if why not in ("small-instance", "not-scheduling-shaped"):
+                self._degrades_total += 1
+                self.trace.emit(
+                    "DEGRADE", round_num=ir.stats.round_num,
+                    detail={"why": why, "backend": outcome.backend},
+                )
+        stats.degrades_total = self._degrades_total
 
         # the decision layer: diff the solved assignment against current
         # placements into typed PLACE | MIGRATE | PREEMPT | NOOP records

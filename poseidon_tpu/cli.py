@@ -126,6 +126,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="churn budget: MIGRATE+PREEMPT deltas actuated "
                         "per round (0 = unlimited); excess deltas are "
                         "deferred and re-proposed next round")
+    # the scale lane: shard the resident round over a device mesh and/
+    # or collapse the machine axis to equivalence classes, so the dense
+    # table fits the HBM budget at 64k-machine / 512k-pod scale instead
+    # of degrading to the CPU oracle (graph/aggregate.py, parallel/)
+    p.add_argument("--mesh_width", type=int, default=0,
+                   help="shard the resident round's task axis over N "
+                        "devices (power of two; 0 = plain single-"
+                        "device layout, 1 = one-device mesh — bit-"
+                        "identical results either way)")
+    p.add_argument("--aggregate_classes",
+                   default="false", choices=["true", "false"],
+                   help="collapse the machine axis to cost-equivalence "
+                        "classes before the dense solve (exact; "
+                        "machines named by preference arcs stay "
+                        "individually addressable); requires a "
+                        "signature-pricing cost model (all registry "
+                        "models except random)")
+    p.add_argument("--topk_prefs", type=int, default=0,
+                   help="keep only each task's K heaviest preference "
+                        "arcs (0 = keep all; exact when K covers every "
+                        "task's prefs, a stated approximation below "
+                        "that; rebalancing continuation arcs are never "
+                        "pruned)")
     p.add_argument("--max_solver_runtime", type=int,
                    default=1_000_000_000,
                    help="microseconds; bounds one oracle-fallback solve "
@@ -291,6 +314,9 @@ def run_loop(args: argparse.Namespace) -> int:
         enable_preemption=args.enable_preemption == "true",
         migration_hysteresis=args.migration_hysteresis,
         max_migrations_per_round=args.max_migrations_per_round,
+        mesh_width=args.mesh_width,
+        aggregate_classes=args.aggregate_classes == "true",
+        topk_prefs=args.topk_prefs,
     )
     incremental = args.run_incremental_scheduler == "true"
     pipelined = args.round_pipeline == "true"

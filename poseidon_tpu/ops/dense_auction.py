@@ -126,30 +126,70 @@ DENSE_TABLE_BUDGET_BYTES = (
 )
 
 
+def _budget_need(
+    Tp: int, Mp: int, n_variants: int, side_ints_per_variant: int,
+    extra_ints: int, mesh_width: int,
+) -> int:
+    per_device_table = -(-Tp * Mp // max(mesh_width, 1))
+    return (per_device_table + side_ints_per_variant) * 4 * n_variants \
+        + extra_ints * 4
+
+
 def check_table_budget(
     Tp: int, Mp: int, n_variants: int = 1,
     side_ints_per_variant: int = 0, extra_ints: int = 0,
+    mesh_width: int = 1,
 ) -> None:
     """Raise DenseMemoryTooLarge if n_variants dense [Tp, Mp] i32
-    tables exceed the configured HBM budget.
+    tables exceed the configured PER-DEVICE HBM budget.
 
     ``side_ints_per_variant`` counts per-variant i32 arrays beyond the
     main table (the what-if batch carries perturbed u[Tp] / w[Tp] /
     dgen[Mp] side tables alongside each c[Tp, Mp]); ``extra_ints``
     counts one-off i32 scratch (the perturb kernel's generic/pref-part
     [Tp, Mp] intermediates). Both default to 0 so the single-instance
-    estimate is exactly the main table.
+    estimate is exactly the main table. ``mesh_width`` is the task-axis
+    shard count (parallel/ resident lane): the table's per-device slice
+    shrinks to Tp/width rows, which is the whole point of sharding the
+    round.
+
+    An overflow's message is ACTIONABLE, not just diagnostic: it names
+    the smallest mesh width that would fit this shape, and the
+    aggregation settings (--aggregate_classes / --topk_prefs) that
+    shrink the machine axis to its equivalence classes — the two scale
+    attacks the operator can actually turn on.
     """
-    need = (Tp * Mp + side_ints_per_variant) * 4 * n_variants \
-        + extra_ints * 4
-    if need > DENSE_TABLE_BUDGET_BYTES:
-        raise DenseMemoryTooLarge(
-            f"dense cost table {n_variants} x [{Tp}, {Mp}] i32 "
-            f"(+ {side_ints_per_variant} side ints/variant, "
-            f"{extra_ints} scratch ints) = {need >> 20} MiB exceeds "
-            f"the {DENSE_TABLE_BUDGET_BYTES >> 20} MiB budget "
-            f"(POSEIDON_TPU_DENSE_TABLE_BUDGET_MB)"
+    need = _budget_need(
+        Tp, Mp, n_variants, side_ints_per_variant, extra_ints,
+        mesh_width,
+    )
+    if need <= DENSE_TABLE_BUDGET_BYTES:
+        return
+    fit_w = max(mesh_width, 1)
+    while fit_w < 1024 and _budget_need(
+        Tp, Mp, n_variants, side_ints_per_variant, extra_ints, fit_w
+    ) > DENSE_TABLE_BUDGET_BYTES:
+        fit_w *= 2
+    if _budget_need(
+        Tp, Mp, n_variants, side_ints_per_variant, extra_ints, fit_w
+    ) <= DENSE_TABLE_BUDGET_BYTES:
+        mesh_hint = (
+            f"a task-sharded mesh of width >= {fit_w} would fit "
+            f"(--mesh_width={fit_w})"
         )
+    else:
+        mesh_hint = "no practical mesh width fits this shape alone"
+    raise DenseMemoryTooLarge(
+        f"dense cost table {n_variants} x [{Tp}, {Mp}] i32 "
+        f"(+ {side_ints_per_variant} side ints/variant, "
+        f"{extra_ints} scratch ints, mesh width {max(mesh_width, 1)}) "
+        f"= {need >> 20} MiB/device exceeds the "
+        f"{DENSE_TABLE_BUDGET_BYTES >> 20} MiB budget "
+        f"(POSEIDON_TPU_DENSE_TABLE_BUDGET_MB); {mesh_hint}; "
+        f"--aggregate_classes collapses the machine axis to its "
+        f"equivalence classes (add --topk_prefs=K to cap preference "
+        f"columns), typically orders of magnitude fewer columns"
+    )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,6 +20,20 @@ single readback is the round's one unavoidable host sync — a flat
 ~100 ms on this environment's link (measured, ``bench.bench_tunnel``),
 ~us on directly-attached hardware.
 
+The scale lane (PR 6) composes two attacks onto the same fused chain:
+**equivalence-class aggregation** (graph/aggregate.py) collapses the
+machine axis to one column per cost-equivalence class before densify —
+the plan is computed host-side from the cost model's per-machine INPUT
+signature, so no pricing sync is needed — and the fetched assignment
+expands back to real machines in finish_round (current placements
+preserved); **sharded resident rounds** (parallel/) lay the round's one
+batched upload out task-sharded over a ``--mesh_width`` device mesh, so
+the dense table, bid windows and seat sorts are Tp/width rows per
+device and HBM/compute scale with mesh width. Both are exact: class
+members are interchangeable by construction and the SPMD program
+computes the same function bit-for-bit (tests/test_aggregate.py,
+tests/test_scale.py).
+
 Fallbacks: a cost table outside the auction's integer domain (checked
 on device, read back with the result batch), a dense table beyond the
 HBM budget, or an uncertified solve degrades to the C++ CPU oracle —
@@ -49,10 +63,19 @@ from poseidon_tpu.guards import (
     no_implicit_transfers,
     sanctioned_transfer,
 )
+from poseidon_tpu.graph.aggregate import (
+    aggregate_topology,
+    expand_assignment,
+    plan_from_signatures,
+    prune_topology_prefs,
+)
 from poseidon_tpu.graph.builder import GraphMeta
 from poseidon_tpu.graph.network import FlowNetwork, pad_bucket
 from poseidon_tpu.models import get_cost_model
-from poseidon_tpu.models.costs import build_cost_inputs_host
+from poseidon_tpu.models.costs import (
+    COST_MODEL_SELECTORS,
+    build_cost_inputs_host,
+)
 from poseidon_tpu.ops.dense_auction import (
     I32,
     INF,
@@ -416,6 +439,11 @@ class InflightSolve:
     Mp: int = 0
     T: int = 0
     n_machines: int = 0
+    # scale lane: the machine-axis equivalence partition this round
+    # solved over (None = all-pairs), and the base topology's per-
+    # machine slots for the class -> machine expansion
+    agg_plan: object = None
+    base_slots: object = None
     timings: dict | None = None
     t_dispatch: float = 0.0
     # set by finish_round on first join; guards double-finish (a
@@ -442,11 +470,29 @@ class ResidentSolver:
         oracle_timeout_s: float = 1000.0,
         small_to_oracle: bool = True,
         fetch_timeout_s: float | None = None,
+        mesh_width: int = 0,
+        aggregate_classes: bool = False,
+        topk_prefs: int = 0,
     ):
         self.alpha = alpha
         self.max_rounds = max_rounds
         self.oracle_fallback = oracle_fallback
         self.oracle_timeout_s = oracle_timeout_s
+        # ---- the scale lane (graph/aggregate.py + parallel/) ----
+        # mesh_width 0 = the plain single-device layout; >= 1 lays the
+        # round out over a task-axis mesh of that width (width 1 is a
+        # 1-device mesh — bit-identical to plain, the equivalence
+        # anchor tests/test_scale.py pins). aggregate_classes collapses
+        # the machine axis to its equivalence classes before densify;
+        # topk_prefs caps preference columns (0 = keep all).
+        self.mesh_width = mesh_width
+        self.aggregate_classes = aggregate_classes
+        self.topk_prefs = topk_prefs
+        self._mesh = None
+        if mesh_width:
+            from poseidon_tpu.parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(mesh_width)
         # deadline on the background placement fetch (the pipelined
         # path's analog of --max_solver_runtime, which previously only
         # bounded the oracle subprocess); None = same budget as the
@@ -578,6 +624,20 @@ class ResidentSolver:
                 # models only need the arc metadata) and solve on the
                 # oracle, the same degradation solve_scheduling provides
                 return degrade("not-scheduling-shaped", None)
+        # ---- the scale lane: prune prefs, aggregate the machine axis
+        # (graph/aggregate.py). The BASE topology (original machine
+        # axis, pruned pref columns) is what the outcome reports and
+        # the oracle degrade path prices; the SOLVE topology is what
+        # the dense chain runs over — identical unless aggregation is
+        # on, in which case its machine axis is the equivalence-class
+        # columns and the fetched assignment expands back through the
+        # plan in finish_round.
+        if self.topk_prefs:
+            topo = prune_topology_prefs(
+                topo, meta.arc_weight, meta.arc_discount,
+                self.topk_prefs,
+            )
+        base_topo = topo
         T, P = topo.n_tasks, topo.max_prefs
         from poseidon_tpu.solver import is_small_instance
 
@@ -592,14 +652,36 @@ class ResidentSolver:
         ):
             # tiny instance: the subprocess oracle beats the TPU launch
             # floor (solver.SMALL_INSTANCE_* documents the measurement)
-            return degrade("small-instance", topo, price_on_cpu=True)
+            return degrade("small-instance", base_topo,
+                           price_on_cpu=True)
+        agg_plan = None
+        if self.aggregate_classes:
+            name = cost_model
+            if isinstance(name, str) and name.isdigit():
+                name = COST_MODEL_SELECTORS.get(int(name), name)
+            if name == "random":
+                raise ValueError(
+                    "aggregate_classes requires a cost model that "
+                    "prices machines by their signature; 'random' "
+                    "hashes the machine index (see graph/aggregate.py)"
+                )
+            kw = cost_input_kwargs or {}
+            agg_plan = plan_from_signatures(
+                base_topo,
+                machine_load=kw.get("machine_load"),
+                machine_mem_free=kw.get("machine_mem_free"),
+                machine_used_slots=kw.get("machine_used_slots"),
+            )
+            topo = aggregate_topology(base_topo, agg_plan)
         dt_host = pad_topology(
             topo, t_min=self._t_floor, m_min=self._m_floor
         )
         Tp = dt_host.arc_unsched.shape[0]
         Mp = dt_host.slots.shape[0]
         try:
-            check_table_budget(Tp, Mp)
+            check_table_budget(
+                Tp, Mp, mesh_width=max(self.mesh_width, 1)
+            )
         except DenseMemoryTooLarge as e:
             # degrade loudly BEFORE any device allocation: the guard,
             # not an OOM mid-_redensify, decides oversize instances.
@@ -615,7 +697,7 @@ class ResidentSolver:
                 "resident round exceeds the dense HBM budget (%s); "
                 "degrading to oracle", e,
             )
-            return degrade("memory-envelope", topo)
+            return degrade("memory-envelope", base_topo)
         self._t_floor = Tp
         self._m_floor = Mp
         # power-of-two smax bound: top_k cost grows mildly with smax but
@@ -661,7 +743,24 @@ class ResidentSolver:
 
         t0 = time.perf_counter()
         with no_implicit_transfers():
-            inputs_dev, dt = jax.device_put((inputs_host, dt_host))
+            if self._mesh is not None:
+                # the parallel/ production lane: one batched upload
+                # laid out task-sharded / machine-replicated — the
+                # fused chain compiles as an SPMD program whose dense
+                # table is Tp/width rows per device, bit-identical to
+                # the plain layout
+                from poseidon_tpu.parallel.sharded import (
+                    resident_round_shardings,
+                )
+
+                in_spec, dt_spec = resident_round_shardings(
+                    self._mesh, dt_host
+                )
+                inputs_dev, dt = jax.device_put(
+                    (inputs_host, dt_host), (in_spec, dt_spec)
+                )
+            else:
+                inputs_dev, dt = jax.device_put((inputs_host, dt_host))
             timings["upload_ms"] = (time.perf_counter() - t0) * 1000
 
             t_dispatch = time.perf_counter()
@@ -699,7 +798,7 @@ class ResidentSolver:
             cost_dev=cost_dev,
             arrays=arrays,
             meta=meta,
-            topo=topo,
+            topo=base_topo,
             dt=dt,
             inputs_dev=inputs_dev,
             model_fn=model_fn,
@@ -710,7 +809,9 @@ class ResidentSolver:
             Tp=Tp,
             Mp=Mp,
             T=T,
-            n_machines=topo.n_machines,
+            n_machines=base_topo.n_machines,
+            agg_plan=agg_plan,
+            base_slots=base_topo.slots,
             timings=timings,
             t_dispatch=t_dispatch,
         )
@@ -834,10 +935,24 @@ class ResidentSolver:
         self._warm = state
         Mp = inflight.Mp
         asg = np.asarray(asg_np[:T], np.int32)  # noqa: PTA001 -- asg_np is already-fetched HOST data (the sanctioned fetch above)
-        asg = np.where(
-            (asg >= 0) & (asg < Mp) & (asg < inflight.n_machines),
-            asg, -1,
-        ).astype(np.int32)
+        plan = inflight.agg_plan
+        if plan is not None:
+            # scale lane: the solve ran over equivalence-class columns;
+            # expand the winning class assignment back to real machines
+            # (current placements preserved, so deltas reflect genuine
+            # moves — graph/aggregate.py::expand_assignment)
+            cols = np.where(
+                (asg >= 0) & (asg < plan.n_cols), asg, -1
+            ).astype(np.int32)
+            asg = expand_assignment(
+                plan, inflight.base_slots,
+                inflight.meta.task_current, cols,
+            )
+        else:
+            asg = np.where(
+                (asg >= 0) & (asg < Mp) & (asg < inflight.n_machines),
+                asg, -1,
+            ).astype(np.int32)
         return ResidentOutcome(
             assignment=asg,
             channel=np.asarray(ch_np[:T], np.int32),  # noqa: PTA001 -- already-fetched host data

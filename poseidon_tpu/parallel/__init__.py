@@ -12,6 +12,7 @@ ICI), and the exactness certificate has an explicit ``shard_map`` +
 from poseidon_tpu.parallel.mesh import make_mesh
 from poseidon_tpu.parallel.sharded import (
     collective_account,
+    resident_round_shardings,
     shard_instance,
     sharded_certificate_gap,
     solve_dense_sharded,
@@ -20,6 +21,7 @@ from poseidon_tpu.parallel.sharded import (
 __all__ = [
     "collective_account",
     "make_mesh",
+    "resident_round_shardings",
     "shard_instance",
     "sharded_certificate_gap",
     "solve_dense_sharded",

@@ -38,6 +38,7 @@ a 100k-task x 12k-machine cluster is firmly in the width-8 win region.
 
 from __future__ import annotations
 
+import dataclasses
 import re
 
 import jax
@@ -95,6 +96,49 @@ def solve_dense_sharded(
     return solve_dense(
         sharded, warm=warm, alpha=alpha, max_rounds=max_rounds
     )
+
+
+# The resident round's task-major topology fields (ops/resident.py
+# DenseTopology): these shard over the mesh's task axis in the
+# production lane; machine-side tables and the n_tasks scalar replicate
+# (O(M) ints, thousands of times smaller than the [T, M] table).
+RESIDENT_TASK_FIELDS = frozenset({
+    "arc_unsched", "arc_cluster", "arc_u2s",
+    "arc_pref", "pref_machine", "pref_rack",
+})
+
+
+def resident_round_shardings(mesh: Mesh, dt_host):
+    """(inputs_sharding, topology_sharding_tree) for one resident round.
+
+    This is the ``parallel/`` promotion from certificate artifact to
+    production lane: the bridge's resident solver lays its ONE batched
+    upload out with these shardings and the UNCHANGED fused chain
+    (cost model → densify → solve → finalize) compiles as an SPMD
+    program whose [T, M] table, bid windows and seat sorts are
+    task-sharded — HBM and compute scale with mesh width, results
+    bit-identical to single-device (the partitioned program computes
+    the same function; asserted by tests/test_scale.py).
+
+    ``dt_host`` is the host DenseTopology dataclass; pricing inputs
+    (arc-major CostInputs, O(arcs) ints) replicate — the model's output
+    cost vector is gathered by the task-sharded index maps, so the
+    derived dense table comes out task-sharded without any resharding.
+    """
+    axis = mesh.axis_names[0]
+    repl = NamedSharding(mesh, P())
+
+    def spec(f):
+        v = getattr(dt_host, f.name)
+        if f.name in RESIDENT_TASK_FIELDS:
+            nd = getattr(v, "ndim", 0)
+            return NamedSharding(mesh, P(axis, *([None] * (nd - 1))))
+        return repl
+
+    dt_spec = type(dt_host)(
+        **{f.name: spec(f) for f in dataclasses.fields(dt_host)}
+    )
+    return repl, dt_spec
 
 
 _COLLECTIVE_OPS = (

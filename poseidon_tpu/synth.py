@@ -165,6 +165,99 @@ def config6_rebalance(
     return ClusterState(machines=machines, tasks=tasks)
 
 
+def config8_scale(
+    n_machines: int = 65_536,
+    n_tasks: int = 524_288,
+    *,
+    seed: int = 0,
+    machines_per_rack: int = 512,
+    n_skus: int = 2,
+    max_tasks_per_machine: int = 10,
+) -> ClusterState:
+    """Config 8 (scale_ceiling): the cluster the single-chip dense
+    table cannot hold — ROADMAP item 1's 64k machines / 512k pods.
+
+    Shaped like a real hyperscale fleet: a small number of hardware
+    SKUs (homogeneous machines are the norm at this scale — machine
+    diversity shows up as a handful of SKU classes, which is exactly
+    what equivalence-class aggregation exploits), big racks, and
+    rack-level data preferences (input data is replicated per
+    rack/cell, so tasks prefer a rack, not one machine — machine-level
+    pins would force singleton classes). Preference weights and
+    ``wait_rounds`` are kept small so the quincy cost domain stays
+    inside the auction's int32 envelope at T = 512k (the scaled-cost
+    bound 2*cmax*(T+1) < 2^27 admits per-arc costs < ~128 there; see
+    ops/dense_auction.py's overflow analysis), and capacity has ~25%
+    headroom so placed pods do not starve and age past the bound.
+    """
+    rng = np.random.default_rng(seed)
+    n_racks = max(
+        1, (n_machines + machines_per_rack - 1) // machines_per_rack
+    )
+    # SKUs differ in their allocatable/capacity RATIOS (what the
+    # knowledge base actually aggregates), so each SKU is a distinct
+    # utilization band and classes = racks x SKUs as documented
+    skus = [
+        (16.0, 12.0, 2 << 24, 1 << 24),   # cpu .75, mem .5
+        (32.0, 16.0, 4 << 24, 3 << 24),   # cpu .5,  mem .75
+        (8.0, 7.0, 1 << 24, 1 << 23),     # cpu .875, mem .5
+        (64.0, 16.0, 8 << 24, 2 << 24),   # cpu .25, mem .25
+    ][: max(n_skus, 1)]
+    machines = []
+    for i in range(n_machines):
+        cpu_cap, cpu_alloc, mem_cap, mem_alloc = skus[
+            (i // n_racks) % len(skus)
+        ]
+        machines.append(Machine(
+            name=f"m{i:06d}",
+            rack=f"rack{i % n_racks:04d}",
+            cpu_capacity=cpu_cap,
+            cpu_allocatable=cpu_alloc,
+            memory_capacity_kb=mem_cap,
+            memory_allocatable_kb=mem_alloc,
+            max_tasks=max_tasks_per_machine,
+        ))
+    home = rng.integers(0, n_racks, size=n_tasks)
+    weight = rng.integers(1, 4, size=n_tasks)
+    tasks = [
+        Task(
+            uid=f"pod-{j:07d}",
+            job=f"job-{j // 16:06d}",
+            cpu_request=0.25,
+            memory_request_kb=1 << 18,
+            data_prefs={f"rack{int(home[j]):04d}": int(weight[j])},
+            wait_rounds=0,
+        )
+        for j in range(n_tasks)
+    ]
+    return ClusterState(machines=machines, tasks=tasks)
+
+
+def config8_arrivals(
+    n_racks: int,
+    n_new: int,
+    round_no: int,
+    *,
+    seed: int = 0,
+) -> list[Task]:
+    """Per-round arrival burst for the scale_ceiling churn rounds,
+    shaped like ``config8_scale``'s pods."""
+    rng = np.random.default_rng(seed + round_no)
+    home = rng.integers(0, n_racks, size=n_new)
+    weight = rng.integers(1, 4, size=n_new)
+    return [
+        Task(
+            uid=f"pod-r{round_no:03d}-{j:06d}",
+            job=f"job-r{round_no:03d}-{j // 16:05d}",
+            cpu_request=0.25,
+            memory_request_kb=1 << 18,
+            data_prefs={f"rack{int(home[j]):04d}": int(weight[j])},
+            wait_rounds=0,
+        )
+        for j in range(n_new)
+    ]
+
+
 def config4_trace_replay(
     n_machines: int = 12_000,
     *,

@@ -12,9 +12,12 @@ from`` names the old machine), PREEMPT (rebalancing park), EVICT (node
 loss), FINISH (pod retired), WATCH_RESYNC (the watch subsystem degraded
 to a full LIST resync — ``detail.reason`` names why: 410 Gone, decode
 error, or staleness), WATCH_RECONNECT (an error-path watch-stream
-reconnect, ``detail.resource``/``detail.reason``) and FETCH_TIMEOUT
+reconnect, ``detail.resource``/``detail.reason``), FETCH_TIMEOUT
 (the pipelined round's background placement fetch missed its
-``--max_solver_runtime`` deadline; the round is abandoned loudly),
+``--max_solver_runtime`` deadline; the round is abandoned loudly) and
+DEGRADE (the dense lane fell back to the CPU oracle this round —
+``detail.why`` names the guard: memory-envelope, cost-domain, or
+uncertified; counted in ``SchedulerStats.degrades_total``),
 plus ROUND records carrying the per-phase timing/stat payload
 (``SchedulerStats`` as a dict — including the round-pipeline timers:
 ``build_mode`` delta/full/legacy, ``dispatch_ms``, ``fetch_wait_ms``,
@@ -52,6 +55,7 @@ EVENT_TYPES = frozenset({
     "WATCH_RESYNC",     # watch degraded to a full LIST resync
     "WATCH_RECONNECT",  # error-path watch-stream reconnect
     "FETCH_TIMEOUT",    # pipelined placement fetch missed its deadline
+    "DEGRADE",          # dense lane degraded this round to the oracle
 })
 
 

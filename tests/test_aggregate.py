@@ -1,0 +1,327 @@
+"""Aggregation exactness: the class-level optimum IS the all-pairs
+optimum (graph/aggregate.py).
+
+The differential proof the scale lane rests on, fuzzed instance by
+instance: partition machines into equivalence classes, solve the
+aggregated transportation problem, expand the winning class assignment
+back to machines, and check (a) the aggregated optimal cost equals the
+all-pairs optimal cost (oracle-verified), (b) the expanded assignment
+prices to exactly that optimum under the ORIGINAL instance, respects
+every real machine's slots, and (c) the extracted
+PLACE/MIGRATE/PREEMPT deltas match the all-pairs lane — with
+preemption on and off.
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.cluster import ClusterState, Machine, Task, TaskPhase
+from poseidon_tpu.graph.aggregate import (
+    aggregate_topology,
+    expand_assignment,
+    plan_from_costs,
+    plan_from_signatures,
+    prune_topology_prefs,
+)
+from poseidon_tpu.graph.builder import FlowGraphBuilder
+from poseidon_tpu.graph.deltas import extract_deltas
+from poseidon_tpu.ops.dense_auction import solve_transport_dense
+from poseidon_tpu.ops.transport import (
+    assignment_cost,
+    extract_topology,
+    instance_from_topology,
+)
+from poseidon_tpu.oracle import solve_oracle
+
+from tests.helpers import price, random_cluster
+
+
+def _priced(rng, n_machines, n_tasks, model="quincy", preemption=False):
+    cluster = random_cluster(rng, n_machines, n_tasks)
+    fb = FlowGraphBuilder(preemption=preemption)
+    net, meta = fb.build(cluster)
+    net = price(net, meta, model, cluster)
+    host = net.to_host()
+    topo = extract_topology(meta, host["src"], host["dst"], host["cap"])
+    return net, meta, topo, host["cost"]
+
+
+def _solve_agg(topo, plan, cost):
+    agg_topo = aggregate_topology(topo, plan)
+    agg_inst = instance_from_topology(agg_topo, cost)
+    res, _ = solve_transport_dense(agg_inst)
+    assert res.converged
+    return res
+
+
+class TestPlan:
+    def test_pinned_machines_are_singletons(self):
+        rng = np.random.default_rng(0)
+        net, meta, topo, cost = _priced(rng, 10, 60)
+        plan = plan_from_costs(topo, cost)
+        pm = topo.pref_machine[topo.pref_machine >= 0]
+        for m in np.unique(pm):
+            col = plan.col_of_machine[m]
+            members = np.flatnonzero(plan.col_of_machine == col)
+            assert len(members) == 1 and members[0] == m
+
+    def test_col_slots_sum_to_machine_slots(self):
+        rng = np.random.default_rng(1)
+        net, meta, topo, cost = _priced(rng, 12, 50)
+        plan = plan_from_costs(topo, cost)
+        assert plan.col_slots.sum() == topo.slots.sum()
+        np.testing.assert_array_equal(
+            np.bincount(
+                plan.col_of_machine, weights=topo.slots,
+                minlength=plan.n_cols,
+            ).astype(np.int64),
+            plan.col_slots.astype(np.int64),
+        )
+
+    def test_members_share_priced_signature(self):
+        rng = np.random.default_rng(2)
+        net, meta, topo, cost = _priced(rng, 16, 40)
+        plan = plan_from_costs(topo, cost)
+        inst = instance_from_topology(topo, cost)
+        for c in range(plan.n_cols):
+            members = np.flatnonzero(plan.col_of_machine == c)
+            assert len(np.unique(inst.d[members])) == 1
+            assert len(np.unique(inst.ra[members])) == 1
+            assert len(np.unique(topo.rack_of[members])) == 1
+
+
+class TestExactness:
+    """The theorem, fuzzed: aggregated optimum == all-pairs optimum."""
+
+    @pytest.mark.parametrize("model", ["trivial", "quincy", "octopus",
+                                       "coco", "random"])
+    def test_cost_plan_exact_across_models(self, model):
+        # plan_from_costs keys on PRICED signatures, so it is exact for
+        # every model — including random, which hashes machine indices
+        rng = np.random.default_rng(3)
+        for trial in range(4):
+            net, meta, topo, cost = _priced(rng, 10, 50, model=model)
+            oracle = solve_oracle(net, algorithm="cost_scaling")
+            plan = plan_from_costs(topo, cost)
+            res = _solve_agg(topo, plan, cost)
+            assert res.cost == oracle.cost, (model, trial)
+
+    def test_signature_plan_exact_for_signature_models(self):
+        # plan_from_signatures keys on the models' per-machine INPUTS
+        # (the resident lane's pre-pricing plan): exact for models that
+        # price machines by signature
+        rng = np.random.default_rng(4)
+        for trial in range(4):
+            cluster = random_cluster(rng, 10, 50)
+            net, meta = FlowGraphBuilder().build(cluster)
+            load = np.round(
+                np.random.default_rng(trial).uniform(0, 1, 10) * 4
+            ).astype(np.float32) / 4.0  # banded utilization
+            net = price(net, meta, "octopus", cluster,
+                        machine_load=load)
+            host = net.to_host()
+            topo = extract_topology(
+                meta, host["src"], host["dst"], host["cap"]
+            )
+            oracle = solve_oracle(net, algorithm="cost_scaling")
+            plan = plan_from_signatures(topo, machine_load=load)
+            res = _solve_agg(topo, plan, host["cost"])
+            assert res.cost == oracle.cost, trial
+
+    def test_expansion_prices_to_the_optimum(self):
+        rng = np.random.default_rng(5)
+        for trial in range(6):
+            net, meta, topo, cost = _priced(rng, 12, 60)
+            inst = instance_from_topology(topo, cost)
+            oracle = solve_oracle(net, algorithm="cost_scaling")
+            plan = plan_from_costs(topo, cost)
+            res = _solve_agg(topo, plan, cost)
+            expanded = expand_assignment(
+                plan, topo.slots, meta.task_current, res.assignment
+            )
+            # the expanded assignment is feasible over REAL machines...
+            on = expanded >= 0
+            used = np.bincount(
+                expanded[on], minlength=topo.n_machines
+            )
+            assert (used <= topo.slots).all()
+            # ...and prices to exactly the all-pairs optimum under the
+            # ORIGINAL instance
+            assert assignment_cost(inst, expanded) == oracle.cost, trial
+
+
+class TestDeltas:
+    """Extracted deltas match the all-pairs lane, preemption on + off."""
+
+    @pytest.mark.parametrize("preemption", [False, True])
+    def test_delta_objectives_match_all_pairs(self, preemption):
+        rng = np.random.default_rng(6)
+        for trial in range(5):
+            net, meta, topo, cost = _priced(
+                rng, 10, 50, preemption=preemption
+            )
+            inst = instance_from_topology(topo, cost)
+            # all-pairs lane
+            ap_res, _ = solve_transport_dense(inst)
+            assert ap_res.converged
+            # aggregated lane
+            plan = plan_from_costs(topo, cost)
+            res = _solve_agg(topo, plan, cost)
+            expanded = expand_assignment(
+                plan, topo.slots, meta.task_current, res.assignment
+            )
+            assert res.cost == ap_res.cost, (preemption, trial)
+            assert assignment_cost(inst, expanded) == ap_res.cost
+            d_ap = extract_deltas(meta, ap_res.assignment)
+            d_ag = extract_deltas(meta, expanded)
+            # both delta sets leave the cluster at the same optimum;
+            # under ties the optimum may be reached by different (but
+            # equally many classes of) moves, so compare the invariant
+            # quantities: placements count and the objective
+            assert len(d_ag.place) == len(d_ap.place)
+            assert len(d_ag.unscheduled) == len(d_ap.unscheduled)
+            if preemption:
+                # the keep-pass makes expansion churn-minimal: every
+                # running task whose class assignment is its current
+                # machine's class, on a machine within capacity, stays
+                # put (NOOP stays NOOP after expansion)
+                cur = meta.task_current
+                occ = np.bincount(
+                    cur[cur >= 0], minlength=topo.n_machines
+                )
+                within = occ <= topo.slots
+                keeps = (
+                    (cur >= 0)
+                    & within[np.maximum(cur, 0)]
+                    & (res.assignment
+                       == plan.col_of_machine[np.maximum(cur, 0)])
+                )
+                assert (expanded[keeps] == cur[keeps]).all()
+
+    def test_unique_optimum_deltas_identical(self):
+        """On a constructed instance with a UNIQUE optimum the two
+        lanes' delta sets must be byte-equal, preemption on."""
+        machines = [
+            Machine(name=f"m{i}", rack=f"r{i % 2}", cpu_capacity=8.0,
+                    cpu_allocatable=8.0, memory_capacity_kb=1 << 20,
+                    memory_allocatable_kb=1 << 20, max_tasks=2)
+            for i in range(4)
+        ]
+        # two running tasks whose data lives elsewhere (unique better
+        # machine each), one pending task with a unique pref
+        tasks = [
+            Task(uid="run-a", job="j1", cpu_request=0.1,
+                 memory_request_kb=1, phase=TaskPhase.RUNNING,
+                 machine="m0", data_prefs={"m2": 200}),
+            Task(uid="run-b", job="j1", cpu_request=0.1,
+                 memory_request_kb=1, phase=TaskPhase.RUNNING,
+                 machine="m0", data_prefs={"m3": 150}),
+            Task(uid="pend-c", job="j2", cpu_request=0.1,
+                 memory_request_kb=1, phase=TaskPhase.PENDING,
+                 machine="", data_prefs={"m1": 100}),
+        ]
+        cluster = ClusterState(machines=machines, tasks=tasks)
+        fb = FlowGraphBuilder(preemption=True, migration_hysteresis=5)
+        net, meta = fb.build(cluster)
+        net = price(net, meta, "quincy", cluster)
+        host = net.to_host()
+        topo = extract_topology(
+            meta, host["src"], host["dst"], host["cap"]
+        )
+        inst = instance_from_topology(topo, host["cost"])
+        ap_res, _ = solve_transport_dense(inst)
+        plan = plan_from_costs(topo, host["cost"])
+        res = _solve_agg(topo, plan, host["cost"])
+        expanded = expand_assignment(
+            plan, topo.slots, meta.task_current, res.assignment
+        )
+        assert res.cost == ap_res.cost
+        d_ap = extract_deltas(meta, ap_res.assignment)
+        d_ag = extract_deltas(meta, expanded)
+        assert d_ag.place == d_ap.place
+        assert d_ag.migrate == d_ap.migrate
+        assert d_ag.preempt == d_ap.preempt
+        assert d_ag.noop == d_ap.noop
+
+
+class TestExpansion:
+    def test_keep_pass_preserves_current_members(self):
+        """Tasks already running on a member of their assigned class
+        stay put — NOOP stays NOOP after expansion."""
+        from poseidon_tpu.graph.aggregate import AggregatePlan
+
+        col = np.array([0, 0, 1], np.int32)
+        plan = AggregatePlan(
+            col_of_machine=col,
+            rep_machine=np.array([0, 2], np.int32),
+            col_slots=np.array([3, 2], np.int32),
+            n_machines=3,
+            n_pinned=0,
+        )
+        slots = np.array([2, 1, 2], np.int64)
+        current = np.array([1, -1, 0, 2], np.int32)
+        assignment = np.array([0, 0, 0, 1], np.int32)
+        out = expand_assignment(plan, slots, current, assignment)
+        assert out[0] == 1      # stayed on its member machine
+        assert out[2] == 0      # stayed
+        assert out[3] == 2      # stayed in class 1
+        assert out[1] in (0, 1)  # filled a free class-0 seat
+        used = np.bincount(out[out >= 0], minlength=3)
+        assert (used <= slots).all()
+
+    def test_overfull_column_raises(self):
+        from poseidon_tpu.graph.aggregate import AggregatePlan
+
+        plan = AggregatePlan(
+            col_of_machine=np.array([0], np.int32),
+            rep_machine=np.array([0], np.int32),
+            col_slots=np.array([1], np.int32),
+            n_machines=1,
+            n_pinned=0,
+        )
+        with pytest.raises(ValueError):
+            expand_assignment(
+                plan, np.array([1], np.int64),
+                np.array([-1, -1], np.int32),
+                np.array([0, 0], np.int32),
+            )
+
+
+class TestPruning:
+    def test_identity_when_k_covers_prefs(self):
+        rng = np.random.default_rng(7)
+        net, meta, topo, cost = _priced(rng, 10, 40)
+        pruned = prune_topology_prefs(
+            topo, meta.arc_weight, meta.arc_discount, topo.max_prefs
+        )
+        assert pruned is topo
+
+    def test_continuation_arcs_survive_pruning(self):
+        """Rebalancing continuation arcs are never pruned — dropping
+        one would force a spurious migration."""
+        rng = np.random.default_rng(8)
+        net, meta, topo, cost = _priced(rng, 8, 40, preemption=True)
+        if topo.max_prefs <= 1:
+            pytest.skip("instance drew no multi-pref tasks")
+        pruned = prune_topology_prefs(
+            topo, meta.arc_weight, meta.arc_discount, 1
+        )
+        cont = meta.arc_discount > 0
+        kept = pruned.arc_pref[pruned.arc_pref >= 0]
+        want = np.flatnonzero(cont)
+        assert np.isin(want, kept).all()
+
+    def test_pruned_solve_within_generic_bound(self):
+        """Pruning is a bounded approximation: the pruned optimum can
+        only rise, and never past what the generic channel admits."""
+        rng = np.random.default_rng(9)
+        net, meta, topo, cost = _priced(rng, 10, 50)
+        inst = instance_from_topology(topo, cost)
+        full, _ = solve_transport_dense(inst)
+        pruned = prune_topology_prefs(
+            topo, meta.arc_weight, meta.arc_discount, 1
+        )
+        pinst = instance_from_topology(pruned, cost)
+        pres, _ = solve_transport_dense(pinst)
+        assert full.converged and pres.converged
+        assert pres.cost >= full.cost

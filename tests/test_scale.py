@@ -1,0 +1,254 @@
+"""The scale lane end to end: sharded resident rounds + aggregation
+through the solver and bridge, degrade observability, and the
+actionable HBM-budget guard.
+
+Runs on the conftest-forced 8-virtual-CPU-device platform, so the
+mesh_width=8 paths compile as real SPMD programs (the same shardings
+lower to ICI collectives on a TPU slice).
+"""
+
+import io
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.graph.builder import FlowGraphBuilder
+from poseidon_tpu.ops import dense_auction
+from poseidon_tpu.ops.dense_auction import (
+    DenseMemoryTooLarge,
+    check_table_budget,
+)
+from poseidon_tpu.ops.resident import ResidentSolver
+from poseidon_tpu.oracle import solve_oracle
+from poseidon_tpu.synth import config8_scale, make_synthetic_cluster
+from poseidon_tpu.trace import TraceGenerator, read_trace
+
+from tests.helpers import price
+
+
+def _round_inputs(cluster):
+    arrays, meta = FlowGraphBuilder().build_arrays(cluster)
+    pending = cluster.pending()
+    kw = dict(
+        task_cpu_milli=np.array(
+            [int(t.cpu_request * 1000) for t in pending]
+        ),
+        task_mem_kb=np.array([t.memory_request_kb for t in pending]),
+    )
+    return arrays, meta, kw
+
+
+def _run(cluster, **opts):
+    arrays, meta, kw = _round_inputs(cluster)
+    solver = ResidentSolver(small_to_oracle=False, **opts)
+    out = solver.run_round(
+        arrays, meta, cost_model="quincy", cost_input_kwargs=kw
+    )
+    return out, solver
+
+
+class TestShardedResidentRound:
+    """Acceptance anchor: the sharded lane is bit-identical."""
+
+    def test_mesh1_bit_identical_to_single_device(self):
+        cluster = make_synthetic_cluster(48, 500, seed=21,
+                                         prefs_per_task=2)
+        plain, _ = _run(cluster)
+        mesh1, _ = _run(cluster, mesh_width=1)
+        assert plain.backend == mesh1.backend == "dense_auction"
+        assert plain.cost == mesh1.cost
+        assert (plain.assignment == mesh1.assignment).all()
+        assert (plain.channel == mesh1.channel).all()
+
+    def test_mesh8_bit_identical_to_single_device(self):
+        assert len(jax.devices()) >= 8
+        cluster = make_synthetic_cluster(48, 500, seed=22,
+                                         prefs_per_task=2)
+        plain, _ = _run(cluster)
+        mesh8, _ = _run(cluster, mesh_width=8)
+        assert mesh8.backend == "dense_auction"
+        assert plain.cost == mesh8.cost
+        assert (plain.assignment == mesh8.assignment).all()
+
+    def test_mesh8_warm_rounds_stay_resident(self):
+        """The warm on-HBM state carries across SHARDED rounds like it
+        does on one device (the production steady state)."""
+        cluster = make_synthetic_cluster(48, 400, seed=23,
+                                         prefs_per_task=1)
+        arrays, meta, kw = _round_inputs(cluster)
+        solver = ResidentSolver(small_to_oracle=False, mesh_width=8)
+        first = solver.run_round(
+            arrays, meta, cost_model="quincy", cost_input_kwargs=kw
+        )
+        assert solver.warm is not None
+        second = solver.run_round(
+            arrays, meta, cost_model="quincy", cost_input_kwargs=kw
+        )
+        assert second.backend == "dense_auction"
+        assert second.cost == first.cost
+
+    def test_mesh8_aggregated_exact_vs_oracle(self):
+        """Both scale attacks composed: aggregation + an 8-wide mesh,
+        exact against the oracle on the same priced graph."""
+        cluster = config8_scale(
+            64, 512, seed=5, machines_per_rack=16, n_skus=2
+        )
+        out, _ = _run(cluster, mesh_width=8, aggregate_classes=True,
+                      topk_prefs=2)
+        assert out.backend == "dense_auction"
+        net, meta = FlowGraphBuilder().build(cluster)
+        net = price(net, meta, "quincy", cluster)
+        o = solve_oracle(net, algorithm="cost_scaling")
+        assert out.cost == o.cost
+
+
+class TestAggregatedBridgeRounds:
+    def test_bridge_rounds_with_aggregation_match_plain(self):
+        """Whole-bridge differential: rounds driven with the scale
+        flags on produce the same (exact) costs as the plain lane —
+        and STAY on the dense lane, where the plain all-pairs solve of
+        this heavily-tied instance legitimately exhausts its fuse and
+        falls back to the exact oracle (aggregation collapses the tied
+        columns, so the class-level market converges immediately)."""
+        cluster = config8_scale(
+            32, 300, seed=7, machines_per_rack=8, n_skus=2
+        )
+
+        def drive(check_dense, **flags):
+            br = SchedulerBridge(
+                cost_model="quincy", small_to_oracle=False, **flags
+            )
+            br.observe_nodes(cluster.machines)
+            br.observe_pods(cluster.tasks)
+            costs = []
+            for _ in range(2):
+                res = br.run_scheduler()
+                for uid, m in res.bindings.items():
+                    br.confirm_binding(uid, m)
+                costs.append(res.stats.cost)
+                if check_dense and res.stats.pods_pending:
+                    assert res.stats.backend == "dense_auction"
+                assert res.stats.degrades_total == 0 or not check_dense
+            return costs
+
+        # the plain lane may certify or degrade to the exact oracle
+        # (both produce the optimum); the scale lane must stay dense
+        plain = drive(check_dense=False)
+        scaled = drive(check_dense=True, aggregate_classes=True,
+                       topk_prefs=2, mesh_width=1)
+        assert plain == scaled
+
+    def test_aggregation_rejects_index_hashing_model(self):
+        cluster = make_synthetic_cluster(16, 80, seed=9)
+        arrays, meta, kw = _round_inputs(cluster)
+        solver = ResidentSolver(
+            small_to_oracle=False, aggregate_classes=True
+        )
+        with pytest.raises(ValueError, match="random"):
+            solver.run_round(
+                arrays, meta, cost_model="random",
+                cost_input_kwargs=kw,
+            )
+
+
+class TestDegradeObservability:
+    def test_degrade_counted_and_traced(self, monkeypatch):
+        monkeypatch.setattr(
+            dense_auction, "DENSE_TABLE_BUDGET_BYTES", 1024
+        )
+        sink = io.StringIO()
+        cluster = make_synthetic_cluster(8, 40, seed=11,
+                                         max_tasks_per_machine=8)
+        bridge = SchedulerBridge(
+            cost_model="trivial", small_to_oracle=False,
+            trace=TraceGenerator(sink=sink),
+        )
+        bridge.observe_nodes(cluster.machines)
+        bridge.observe_pods(cluster.tasks)
+        res = bridge.run_scheduler()
+        assert res.stats.backend == "oracle:memory-envelope"
+        assert res.stats.degrades_total == 1
+        events = [
+            json.loads(line) for line in sink.getvalue().splitlines()
+        ]
+        degrades = [e for e in events if e["event"] == "DEGRADE"]
+        assert len(degrades) == 1
+        assert degrades[0]["detail"]["why"] == "memory-envelope"
+        assert degrades[0]["round_num"] == res.stats.round_num
+        # the counter is lifetime: a second degraded round reaches 2
+        res2 = bridge.run_scheduler()
+        assert res2.stats.degrades_total == 2
+
+    def test_small_instance_routing_is_not_a_degrade(self):
+        sink = io.StringIO()
+        cluster = make_synthetic_cluster(6, 30, seed=13)
+        bridge = SchedulerBridge(
+            cost_model="trivial",
+            trace=TraceGenerator(sink=sink),
+        )
+        bridge.observe_nodes(cluster.machines)
+        bridge.observe_pods(cluster.tasks)
+        res = bridge.run_scheduler()
+        assert res.stats.backend == "oracle:small-instance"
+        assert res.stats.degrades_total == 0
+        assert all(
+            json.loads(line)["event"] != "DEGRADE"
+            for line in sink.getvalue().splitlines()
+        )
+
+    def test_degrade_event_in_declared_vocabulary(self):
+        from poseidon_tpu.trace import EVENT_TYPES
+
+        assert "DEGRADE" in EVENT_TYPES
+
+
+class TestBudgetMessage:
+    """Satellite: the overflow message is actionable, not diagnostic."""
+
+    def test_suggests_fitting_mesh_width(self):
+        with pytest.raises(DenseMemoryTooLarge) as ei:
+            check_table_budget(524288, 16384)  # 32 GiB all-pairs
+        msg = str(ei.value)
+        assert "--mesh_width=" in msg
+        assert "--aggregate_classes" in msg
+        # the suggested width actually fits
+        import re
+
+        w = int(re.search(r"--mesh_width=(\d+)", msg).group(1))
+        check_table_budget(524288, 16384, mesh_width=w)
+
+    def test_mesh_width_divides_the_per_device_estimate(self):
+        # over budget at width 1, inside it at width 8
+        with pytest.raises(DenseMemoryTooLarge):
+            check_table_budget(65536, 16384)
+        check_table_budget(65536, 16384, mesh_width=8)
+
+    def test_hopeless_shape_says_so(self):
+        with pytest.raises(DenseMemoryTooLarge) as ei:
+            check_table_budget(2**22, 2**22)  # 64 TiB: no width fits
+        assert "no practical mesh width" in str(ei.value)
+        assert "--aggregate_classes" in str(ei.value)
+
+    def test_trace_reader_orders_degrade_rounds(self, tmp_path,
+                                                monkeypatch):
+        """DEGRADE events ride the normal trace stream and round
+        ordering (read_trace)."""
+        monkeypatch.setattr(
+            dense_auction, "DENSE_TABLE_BUDGET_BYTES", 1024
+        )
+        path = tmp_path / "trace.jsonl"
+        cluster = make_synthetic_cluster(8, 40, seed=17,
+                                         max_tasks_per_machine=8)
+        with open(path, "w") as fh:
+            bridge = SchedulerBridge(
+                cost_model="trivial", small_to_oracle=False,
+                trace=TraceGenerator(sink=fh),
+            )
+            bridge.observe_nodes(cluster.machines)
+            bridge.observe_pods(cluster.tasks)
+            bridge.run_scheduler()
+        events = list(read_trace(str(path)))
+        assert any(e.event == "DEGRADE" for e in events)

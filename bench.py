@@ -1331,16 +1331,225 @@ def bench_scale_ceiling(
     return row
 
 
+def bench_express_latency(
+    *, events: int = 24, warmup: int = 3, full_round_reps: int = 3,
+    seed: int = 0, sync_floor_ms: float = 0.0,
+) -> dict:
+    """Config 9 (express_latency): event-to-bind on the flagship shape.
+
+    Drives the express lane the way the daemon does between ticks: one
+    certified full round warms the on-HBM context, then ``events``
+    single-pod watch-event batches (a completion freeing a seat + an
+    arrival taking one, the flagship is exactly packed) each become ONE
+    fused patch+repair dispatch with ONE sanctioned fetch. Reports
+    event-to-bind-decision p50/p99 and the per-phase decomposition
+    (prep / upload / solve, with the solve's one sync cancelled via the
+    measured ``sync_floor_ms`` like configs 2-4), the cost ratio vs the
+    counterfactual of triggering a full warm round per event, and —
+    asserted in-bench, not just reported —
+
+    - the differential equivalence check: an UNCONFIRMED express
+      placement's machine equals what the next full round chooses for
+      that pod (same shared column patch, same prices, same auction),
+      and the correction round counts zero corrections for it;
+    - zero steady-state recompiles under the express path
+      (``guards.CompileCounter``).
+    """
+    from poseidon_tpu.bridge import SchedulerBridge
+    from poseidon_tpu.cluster import Task
+    from poseidon_tpu.guards import CompileCounter
+    from poseidon_tpu.synth import config2_quincy_flagship
+
+    row: dict = {"config": "express_latency", "model": "quincy"}
+    cluster = config2_quincy_flagship(seed=seed)
+    row["machines"] = len(cluster.machines)
+    row["pods"] = len(cluster.tasks)
+    bridge = SchedulerBridge(
+        cost_model="quincy", small_to_oracle=False, express_lane=True,
+    )
+    bridge.observe_nodes(list(cluster.machines))
+    bridge.observe_pods(list(cluster.tasks))
+
+    log("bench: config 9 warming the round + express context ...")
+    t0 = time.perf_counter()
+    res = bridge.run_scheduler()
+    row["first_round_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+    row["first_round_backend"] = res.stats.backend
+    for uid, m in res.bindings.items():
+        bridge.confirm_binding(uid, m)
+    assert bridge.solver.express_ready, (
+        f"no express context after a {res.stats.backend} round"
+    )
+
+    running = list(res.bindings)
+
+    def one_event(i):
+        """One churn event pair in ONE batch: a completion frees a
+        seat, an arrival preferring that seat's machine binds (the
+        flagship is exactly packed, so an arrival preferring a full
+        machine would certify as unscheduled instead — correct, but
+        not the latency story this config measures)."""
+        done_uid = running.pop(0)
+        done = bridge.tasks[done_uid]
+        pod = Task(
+            uid=f"x9-{i}", cpu_request=0.1, memory_request_kb=128,
+            data_prefs={bridge.pod_to_machine[done_uid]: 400},
+        )
+        t_ev = time.perf_counter()
+        r = bridge.express_batch(
+            [("DELETED", done), ("ADDED", pod)], t_event=t_ev
+        )
+        assert r is not None, "express batch degraded"
+        for uid, m in r.bindings.items():
+            bridge.confirm_binding(uid, m)
+            if uid.startswith("x9-"):
+                running.append(uid)
+        return r
+
+    # ---- warm the express program variants (patch chunks + chain) ----
+    for i in range(warmup):
+        one_event(i)
+
+    # ---- steady state: latency samples under a zero-compile budget ----
+    log(f"bench: config 9 steady state, {events} events ...")
+    lat, solve_ms, prep_ms, upload_ms, placed = [], [], [], [], 0
+    counter = CompileCounter()
+    with counter:
+        for i in range(warmup, warmup + events):
+            r = one_event(i)
+            lat.append(r.latency_ms / 1000)
+            solve_ms.append(r.timings.get("solve_ms", 0.0) / 1000)
+            prep_ms.append(r.timings.get("prep_ms", 0.0) / 1000)
+            upload_ms.append(r.timings.get("upload_ms", 0.0) / 1000)
+            placed += len(r.bindings)
+    row["events"] = events
+    row["express_places"] = placed
+    row["e2b_p50_ms"] = _ms(lat)
+    row["e2b_p99_ms"] = round(
+        float(np.percentile(np.asarray(lat) * 1000, 99)), 3
+    )
+    row["express_solve_p50_ms"] = _ms(solve_ms)
+    row["express_prep_p50_ms"] = _ms(prep_ms)
+    row["express_upload_p50_ms"] = _ms(upload_ms)
+    # the solve column contains exactly ONE host sync (the sanctioned
+    # placement fetch); cancel the measured link floor like configs 2-4
+    row["sync_floor_ms"] = round(sync_floor_ms, 3)
+    row["express_compute_p50_ms"] = round(
+        max(row["express_solve_p50_ms"] - sync_floor_ms, 0.0), 3
+    )
+    row["express_compute_p50_le_2ms"] = bool(
+        0 <= row["express_compute_p50_ms"] <= 2.0
+    )
+    row["steady_state_recompiles"] = (
+        counter.count if counter.supported else None
+    )
+    if counter.supported:
+        assert counter.count == 0, (
+            f"{counter.count} steady-state recompile(s) on the "
+            f"express path"
+        )
+
+    # ---- the counterfactual: a full warm round per event ----
+    log("bench: config 9 full-round-per-event counterfactual ...")
+    full_wall, full_solve = [], []
+    for i in range(full_round_reps):
+        done_uid = running.pop(0)
+        freed_machine = bridge.pod_to_machine[done_uid]
+        bridge.observe_pod_event("DELETED", bridge.tasks[done_uid])
+        pod = Task(
+            uid=f"x9f-{i}", cpu_request=0.1, memory_request_kb=128,
+            data_prefs={freed_machine: 400},
+        )
+        bridge.observe_pod_event("ADDED", pod)
+        t0 = time.perf_counter()
+        res = bridge.run_scheduler()
+        full_wall.append(time.perf_counter() - t0)
+        full_solve.append(res.stats.solve_ms / 1000)
+        for uid, m in res.bindings.items():
+            bridge.confirm_binding(uid, m)
+    row["full_round_wall_p50_ms"] = _ms(full_wall)
+    row["full_round_solve_p50_ms"] = _ms(full_solve)
+    full_compute = max(
+        row["full_round_solve_p50_ms"] - sync_floor_ms, 0.0
+    )
+    row["full_round_compute_p50_ms"] = round(full_compute, 3)
+    if row["e2b_p50_ms"] > 0:
+        row["express_vs_full_round_wall"] = round(
+            row["full_round_wall_p50_ms"] / row["e2b_p50_ms"], 2
+        )
+    if row["express_compute_p50_ms"] > 0:
+        row["express_vs_full_round_compute"] = round(
+            full_compute / row["express_compute_p50_ms"], 2
+        )
+    row["express_10x_cheaper"] = bool(
+        row.get("express_vs_full_round_compute",
+                row.get("express_vs_full_round_wall", 0.0)) >= 10.0
+    )
+
+    # ---- differential equivalence, asserted (the correction
+    # contract): an unconfirmed express placement's machine equals the
+    # next full round's choice for that pod. Runs on an
+    # under-subscribed dense instance: the exactly-packed flagship
+    # keeps a standing unscheduled pool whose wait-aging reprices
+    # every round, so per-pod choices there legitimately shift between
+    # windows — which is precisely what the correction pass exists for
+    # (the confirmed-placement form of that contract is fuzz-tested
+    # across churn mixes in tests/test_express.py::TestDifferential) ----
+    log("bench: config 9 differential equivalence check ...")
+    from poseidon_tpu.synth import make_synthetic_cluster
+
+    diff_cluster = make_synthetic_cluster(
+        128, 1000, seed=seed + 1, prefs_per_task=2
+    )
+    diff_bridge = SchedulerBridge(
+        cost_model="quincy", small_to_oracle=False, express_lane=True,
+    )
+    diff_bridge.observe_nodes(list(diff_cluster.machines))
+    diff_bridge.observe_pods(list(diff_cluster.tasks))
+    res_d = diff_bridge.run_scheduler()
+    for uid, m in res_d.bindings.items():
+        diff_bridge.confirm_binding(uid, m)
+    diff_pods = [
+        Task(uid=f"x9d-{k}", cpu_request=0.1, memory_request_kb=128,
+             data_prefs={diff_cluster.machines[7 * k].name: 400})
+        for k in range(4)
+    ]
+    r = diff_bridge.express_batch(
+        [("ADDED", p) for p in diff_pods]
+    )
+    last_degrade = next(
+        (e.detail for e in reversed(diff_bridge.trace.events)
+         if e.event == "EXPRESS_DEGRADE"), None,
+    )
+    assert r is not None and r.bindings, (
+        f"differential batch degraded: {last_degrade}"
+    )
+    express_choice = dict(r.bindings)
+    res_d = diff_bridge.run_scheduler()  # unconfirmed: re-solved
+    for uid, machine in express_choice.items():
+        assert res_d.bindings.get(uid) == machine, (
+            f"express placed {uid} on {machine}, the correction round "
+            f"chose {res_d.bindings.get(uid)}"
+        )
+    assert res_d.stats.express_corrected == 0
+    row["differential_pods"] = len(express_choice)
+    row["differential_equal"] = True
+    row["express_batches_total"] = warmup + events + 1
+    return row
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--configs",
-        default="1,2,3,4,5,6,7,8",
+        default="1,2,3,4,5,6,7,8,9",
         help="comma list of BASELINE config numbers to run "
              "(6 = the rebalancing drift-correction config, "
              "7 = observe-phase poll vs watch, "
              "8 = scale_ceiling: 64k machines / 512k pods on the "
-             "aggregated + sharded lane)",
+             "aggregated + sharded lane, "
+             "9 = express_latency: event-to-bind on the flagship "
+             "shape via the between-ticks express lane)",
     )
     ap.add_argument("--solve-reps", type=int, default=20)
     ap.add_argument("--oracle-reps", type=int, default=3)
@@ -1414,6 +1623,22 @@ def main() -> int:
                 log(f"bench: config 8 FAILED:\n{traceback.format_exc()}")
                 rows.append(
                     {"config": "scale_ceiling", "config_num": 8,
+                     "error": True}
+                )
+            continue
+        if num == 9:
+            log("bench: running config 9 (express_latency) ...")
+            try:
+                row = bench_express_latency(
+                    sync_floor_ms=tunnel.get("sync_floor_ms", 0.0)
+                )
+                row["config_num"] = 9
+                rows.append(row)
+                log(f"bench: config 9 done: {json.dumps(row)}")
+            except Exception:
+                log(f"bench: config 9 FAILED:\n{traceback.format_exc()}")
+                rows.append(
+                    {"config": "express_latency", "config_num": 9,
                      "error": True}
                 )
             continue

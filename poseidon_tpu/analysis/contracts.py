@@ -91,10 +91,14 @@ DEFAULT_CONTRACTS = Contracts(
             "IncrementalFlowGraphBuilder._apply_deltas",
         ),
         # the begin_round -> finish_round window the pipelined driver
-        # overlaps host work under
+        # overlaps host work under, plus the express fast path (the
+        # event-to-bind latency budget is single-digit ms: one
+        # dispatch, one sanctioned fetch, no host syncs)
         "poseidon_tpu/bridge/bridge.py": (
             "SchedulerBridge.begin_round",
             "SchedulerBridge.finish_round",
+            "SchedulerBridge.express_batch",
+            "SchedulerBridge._express_transitions",
         ),
         # the scale lane: aggregation planning/expansion runs inside
         # the resident round (hot from day one — pure vectorized host
@@ -121,6 +125,8 @@ DEFAULT_CONTRACTS = Contracts(
         "_resident_chain",
         "_redensify",
         "_finalize",
+        "_express_chain",
+        "_express_patch",
         "_solve",
         "_densify",
         "cold_start",
@@ -131,9 +137,14 @@ DEFAULT_CONTRACTS = Contracts(
         "jax.device_get",   # result is HOST data
     ),
     ochurn_functions={
+        # express_batch / _express_transitions / express_round run per
+        # EVENT BATCH, between ticks: an O(cluster) walk there would
+        # turn the single-digit-ms lane back into a round
         "poseidon_tpu/bridge/bridge.py": (
             "SchedulerBridge.begin_round",
             "SchedulerBridge.finish_round",
+            "SchedulerBridge.express_batch",
+            "SchedulerBridge._express_transitions",
         ),
         "poseidon_tpu/graph/builder.py": (
             "IncrementalFlowGraphBuilder.build_arrays",
@@ -142,6 +153,7 @@ DEFAULT_CONTRACTS = Contracts(
         "poseidon_tpu/ops/resident.py": (
             "ResidentSolver.begin_round",
             "ResidentSolver.finish_round",
+            "ResidentSolver.express_round",
         ),
         # aggregation planning/expansion must stay vectorized numpy:
         # a Python walk over machines here is O(cluster) every round

@@ -2,12 +2,17 @@
 
 from poseidon_tpu.apiclient.client import K8sApiClient, parse_cpu, parse_memory_kb
 from poseidon_tpu.apiclient.fake_server import FakeApiServer
-from poseidon_tpu.apiclient.watch import ClusterWatcher, ObserveDelta
+from poseidon_tpu.apiclient.watch import (
+    ClusterWatcher,
+    ExpressEvents,
+    ObserveDelta,
+)
 
 __all__ = [
     "K8sApiClient",
     "FakeApiServer",
     "ClusterWatcher",
+    "ExpressEvents",
     "ObserveDelta",
     "parse_cpu",
     "parse_memory_kb",

@@ -1,9 +1,15 @@
 """L4' — the scheduler bridge (cluster state <-> solver)."""
 
 from poseidon_tpu.bridge.bridge import (
+    ExpressResult,
     RoundResult,
     SchedulerBridge,
     SchedulerStats,
 )
 
-__all__ = ["SchedulerBridge", "SchedulerStats", "RoundResult"]
+__all__ = [
+    "SchedulerBridge",
+    "SchedulerStats",
+    "RoundResult",
+    "ExpressResult",
+]

@@ -45,6 +45,20 @@ Differences from the reference loop, on purpose:
   reconnects are trace events and ``SchedulerStats`` counters. Watch
   composes with ``--round_pipeline`` and ``--enable_preemption``.
 
+- ``--express_lane=true`` (with ``--watch=true``) adds the between-
+  ticks fast path: the inter-tick sleep becomes an express window that
+  blocks on the pods watch stream, turns small event batches into
+  bindings via the warm on-HBM patch + bounded eps=1 repair
+  (``SchedulerBridge.express_batch``), and POSTs them immediately —
+  single-digit-ms event-to-bind instead of waiting for the next tick.
+  Full rounds are demoted to a periodic correction pass
+  (``--express_correction_rounds``) that differential-verifies express
+  placements; anything the express vocabulary cannot represent (node
+  events, stream degradation, oversize batches) degrades loudly to the
+  round path. Serial ticks (``--round_pipeline`` is ignored: the
+  pipeline would park a solve in flight across the very window the
+  express lane lives in).
+
 Run: ``python -m poseidon_tpu.cli --k8s_apiserver_port=8080
 --flow_scheduling_cost_model=quincy --max_rounds=0``
 """
@@ -149,6 +163,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "task's prefs, a stated approximation below "
                         "that; rebalancing continuation arcs are never "
                         "pruned)")
+    # the express lane: small watch-event batches become bindings
+    # BETWEEN round ticks via an on-HBM patch + bounded eps=1 repair of
+    # the last round's warm dense state (ops/resident.py express
+    # kernels); the full resident round is demoted to a periodic
+    # correction pass that differential-verifies express placements.
+    # Requires --watch (events are the trigger) and runs serial ticks
+    # (the inter-tick window IS the express window, so --round_pipeline
+    # — which parks a solve in flight across that window — is ignored)
+    p.add_argument("--express_lane",
+                   default="false", choices=["true", "false"],
+                   help="bind small pod-arrival batches between round "
+                        "ticks by patching the warm on-HBM dense state "
+                        "and running a bounded eps=1 repair (single-"
+                        "digit-ms event-to-bind); full rounds become "
+                        "periodic correction passes. Requires "
+                        "--watch=true; implies serial ticks")
+    p.add_argument("--express_max_batch", type=int, default=16,
+                   help="max pod arrivals per express dispatch (a "
+                        "static kernel shape: ONE compiled variant); "
+                        "larger event bursts degrade to the next full "
+                        "round")
+    p.add_argument("--express_correction_rounds", type=int, default=1,
+                   help="run the full correction round every Nth tick "
+                        "while the express context is live (1 = every "
+                        "tick); a degraded/invalidated express context "
+                        "forces the round on the next tick regardless")
     p.add_argument("--max_solver_runtime", type=int,
                    default=1_000_000_000,
                    help="microseconds; bounds one oracle-fallback solve "
@@ -317,6 +357,8 @@ def run_loop(args: argparse.Namespace) -> int:
         mesh_width=args.mesh_width,
         aggregate_classes=args.aggregate_classes == "true",
         topk_prefs=args.topk_prefs,
+        express_lane=args.express_lane == "true",
+        express_max_batch=args.express_max_batch,
     )
     incremental = args.run_incremental_scheduler == "true"
     pipelined = args.round_pipeline == "true"
@@ -329,6 +371,29 @@ def run_loop(args: argparse.Namespace) -> int:
             client,
             trace=bridge.trace,
             max_lag_s=args.watch_max_lag,
+        )
+    express = args.express_lane == "true"
+    if express and watcher is None:
+        log.warning(
+            "--express_lane needs --watch=true (watch events are the "
+            "express trigger); express lane disabled"
+        )
+        express = False
+    if express and pipelined:
+        # the pipeline parks a solve in flight across the inter-tick
+        # window — exactly where the express lane lives; serial
+        # correction rounds replace it (the express dispatch is the
+        # new latency hider)
+        log.info(
+            "--express_lane runs serial correction rounds; "
+            "--round_pipeline ignored"
+        )
+        pipelined = False
+    if express and not incremental:
+        log.warning(
+            "--express_lane needs warm on-HBM state "
+            "(--run_incremental_scheduler=true); every express batch "
+            "will degrade to the round path"
         )
 
     def _observe_tick() -> bool:
@@ -356,10 +421,69 @@ def run_loop(args: argparse.Namespace) -> int:
         else:
             for typ, machine in delta.node_events:
                 bridge.observe_node_event(typ, machine)
-            for typ, task in delta.pod_events:
-                bridge.observe_pod_event(typ, task)
+            if express:
+                # express lane on: pod events go through the batch
+                # path so the on-HBM context is patched (or degraded
+                # loudly) in lockstep with bridge state — events that
+                # can still bind do so even at tick time
+                _post_express(
+                    bridge.express_batch(delta.pod_events)
+                )
+            else:
+                for typ, task in delta.pod_events:
+                    bridge.observe_pod_event(typ, task)
         bridge.note_watch_activity(delta.resyncs, delta.reconnects)
         return True
+
+    def _post_express(result) -> None:
+        """POST one express batch's bindings; failures re-queue (the
+        bridge invalidates the context, so the next full round owns
+        recovery)."""
+        if result is None or not result.bindings:
+            return
+        for uid, machine, ok in _post_bindings(
+            client, bridge, result.bindings
+        ):
+            if ok:
+                bridge.confirm_binding(uid, machine)
+            else:
+                log.warning(
+                    "express bind POST failed for %s; re-queueing", uid
+                )
+                bridge.binding_failed(uid)
+
+    def _express_window(window_s: float) -> None:
+        """The inter-tick express window: turn small watch-event
+        batches into bindings until the window closes or something
+        outside the express vocabulary arrives (node events, stream
+        degradation — the next tick's observe handles those with the
+        full resync/mass-eviction guards)."""
+        deadline = time.monotonic() + window_s
+        while True:
+            wait = deadline - time.monotonic()
+            if wait <= 0:
+                return
+            ev = watcher.express_poll(
+                wait, max_events=args.express_max_batch
+            )
+            if ev.reconnects:
+                bridge.note_watch_activity(0, ev.reconnects)
+            if ev.pod_events:
+                # always apply consumed pod events, even when the poll
+                # also requests a tick (node event / stream degradation
+                # mid-drain): express_poll already advanced the shared
+                # resourceVersion past them, so tick() would skip them
+                # as replayed history — dropping them here would lose
+                # the pods until an unrelated event re-delivered them.
+                # express_batch applies them through the same observe
+                # transitions whether or not a placement happens.
+                _post_express(
+                    bridge.express_batch(
+                        ev.pod_events, t_event=ev.t_first
+                    )
+                )
+            if ev.needs_tick:
+                return
 
     rounds = 0
     # round-pipeline state: at most one solve in flight across ticks,
@@ -367,6 +491,10 @@ def run_loop(args: argparse.Namespace) -> int:
     inflight = None
     to_post: dict[str, str] = {}
     to_rebal: tuple[dict, dict] = ({}, {})
+    # express-lane demotion state: full rounds become a periodic
+    # correction pass (every --express_correction_rounds ticks) while
+    # the express context is live; a dead context forces the round
+    ticks_since_round = 0
 
     def _log_round(result):
         s = result.stats
@@ -463,22 +591,35 @@ def run_loop(args: argparse.Namespace) -> int:
                         inflight = ir
                     _flush_pending()
                 else:
-                    result = bridge.run_scheduler()
-                    if result.bindings:
-                        for uid, machine, ok in _post_bindings(
-                            client, bridge, result.bindings
-                        ):
-                            if ok:
-                                bridge.confirm_binding(uid, machine)
-                            else:
-                                bridge.binding_failed(uid)
-                    if result.migrations or result.preemptions:
-                        _actuate_rebalance(
-                            client, bridge, result.migrations,
-                            result.preemptions, confirm=True,
-                        )
-                    if _round_done(result, False):
-                        return 0
+                    correction_due = (
+                        not express
+                        or not bridge.solver.express_ready
+                        or ticks_since_round + 1
+                        >= max(args.express_correction_rounds, 1)
+                    )
+                    if not correction_due:
+                        # express context live and no correction due
+                        # this tick: the round is skipped, the express
+                        # window below keeps binding between ticks
+                        ticks_since_round += 1
+                    else:
+                        ticks_since_round = 0
+                        result = bridge.run_scheduler()
+                        if result.bindings:
+                            for uid, machine, ok in _post_bindings(
+                                client, bridge, result.bindings
+                            ):
+                                if ok:
+                                    bridge.confirm_binding(uid, machine)
+                                else:
+                                    bridge.binding_failed(uid)
+                        if result.migrations or result.preemptions:
+                            _actuate_rebalance(
+                                client, bridge, result.migrations,
+                                result.preemptions, confirm=True,
+                            )
+                        if _round_done(result, False):
+                            return 0
             except Exception:
                 # a failed round (oracle timeout, device fault) must not
                 # kill the daemon; state is rebuilt from the next poll
@@ -497,9 +638,13 @@ def run_loop(args: argparse.Namespace) -> int:
                 time.sleep(args.polling_frequency / 1e6)
                 continue
             elapsed = time.perf_counter() - tick_start
-            time.sleep(
-                max(args.polling_frequency / 1e6 - elapsed, 0.0)
-            )
+            remaining = max(args.polling_frequency / 1e6 - elapsed, 0.0)
+            if express and remaining > 0:
+                # the inter-tick sleep IS the express window: block on
+                # the pods watch stream and bind arrivals immediately
+                _express_window(remaining)
+            else:
+                time.sleep(remaining)
     finally:
         if watcher is not None:
             watcher.stop()

@@ -242,6 +242,18 @@ class FlowGraphBuilder:
             if name in midx or name in rack_idx
         ]
 
+    def task_arc_rows(
+        self, task: Task, midx: dict[str, int], rack_idx: dict[str, int]
+    ) -> list[tuple[int, int, int]]:
+        """Public single-event column patch: ONE task's resolved pref
+        rows, exactly as a full extract or an incremental delta build
+        would produce them. The express lane (bridge ``express_batch``
+        -> ``ops/resident.py`` arrival rows) prices arrivals from this
+        same resolution, so the periodic correction round — whose
+        incremental build applies the identical patch — sees an
+        identical graph for the pod."""
+        return self._task_prefs(task, midx, rack_idx)
+
     def extract_columns(self, cluster: ClusterState) -> BuilderColumns:
         """The O(tasks·prefs) Python walk, done once per full rebuild."""
         machines = cluster.machines

@@ -69,7 +69,7 @@ from poseidon_tpu.graph.aggregate import (
     plan_from_signatures,
     prune_topology_prefs,
 )
-from poseidon_tpu.graph.builder import GraphMeta
+from poseidon_tpu.graph.builder import ArcKind, GraphMeta
 from poseidon_tpu.graph.network import FlowNetwork, pad_bucket
 from poseidon_tpu.models import get_cost_model
 from poseidon_tpu.models.costs import (
@@ -269,6 +269,217 @@ def _finalize(dev: DenseInstance, dt: DenseTopology, pc_s, ra_s, asg):
     return ch, primal
 
 
+# ---------------------------------------------------------------------------
+# the express lane: on-HBM patch + bounded eps=1 repair between rounds
+# ---------------------------------------------------------------------------
+
+# Bounded repair fuse: an express batch is 1-K arrivals/completions
+# against warm prices, so the repair is sparse local work; a batch that
+# genuinely needs a price war this long is cheaper as a full round
+# (converged=False -> EXPRESS_DEGRADE, the next round handles it).
+EXPRESS_FUSE = 5_000
+
+
+@jax.jit
+def _express_patch(u, w, task_valid, s, asg, lvl, rows, slot_col,
+                   slot_delta):
+    """Deactivate table rows + apply slot-capacity deltas, on device.
+
+    The retire half of the express patch vocabulary: a pod whose
+    binding POST landed leaves the pending set, so its (seated) row
+    deactivates and its machine's capacity drops by one — net zero on
+    the auction's feasible set, so warm prices stay eps-CS and NO
+    repair is needed (that is why this is a separate cheap scatter
+    program, chunkable for arbitrarily large retire backlogs, while
+    arrivals go through ``_express_chain``'s repair). Also carries
+    bare slot deltas (completions of running pods free a seat, +1).
+    ``rows``/``slot_col`` use -1 for unused entries (mapped out of
+    range so the scatters drop them)."""
+    Tp = task_valid.shape[0]
+    Mp = s.shape[0]
+    ri = jnp.where(rows >= 0, rows, Tp)
+    valid2 = task_valid.at[ri].set(False, mode="drop")
+    u2 = u.at[ri].set(0, mode="drop")
+    w2 = w.at[ri].set(INF, mode="drop")
+    asg2 = asg.at[ri].set(Mp, mode="drop")
+    lvl2 = lvl.at[ri].set(0, mode="drop")
+    ci = jnp.where(slot_col >= 0, slot_col, Mp)
+    s2 = jnp.maximum(s.at[ci].add(slot_delta, mode="drop"), 0)
+    return u2, w2, valid2, s2, asg2, lvl2
+
+
+def _express_patch_chunks(rows, cols, deltas):
+    """Pad retire/slot patches into fixed-width chunks so the patch
+    kernel compiles once (a variable-length scatter would recompile
+    per backlog size)."""
+    out = []
+    n = len(rows)
+    W = _EXPRESS_PATCH_CHUNK
+    for i in range(0, n, W):
+        r = np.full(W, -1, np.int32)
+        c = np.full(W, -1, np.int32)
+        d = np.zeros(W, np.int32)
+        r[: min(W, n - i)] = rows[i: i + W]
+        c[: min(W, n - i)] = cols[i: i + W]
+        d[: min(W, n - i)] = deltas[i: i + W]
+        out.append((r, c, d))
+    return out
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "model_fn", "kmax", "pk", "alpha", "max_rounds", "smax",
+        "change_cap",
+    ),
+)
+def _express_chain(
+    dev: DenseInstance,
+    dt: DenseTopology,
+    cost_dev,
+    mini_inputs,
+    asg, lvl, floor,
+    add_row,      # i32[kmax] padded row to activate (-1 unused)
+    add_pm,       # i32[kmax, pk] pref machine COLUMN (-1 none)
+    add_pr,       # i32[kmax, pk] pref rack index (-1 none)
+    *,
+    model_fn,
+    kmax: int,
+    pk: int,
+    alpha: int,
+    max_rounds: int,
+    smax: int,
+    change_cap: int,
+):
+    """ONE fused dispatch turning a small arrival batch into placements:
+    price the arrivals' task-side arcs with the round's cost model,
+    activate their table rows against the warm on-HBM instance, run a
+    bounded eps=1 repair from the existing prices, and compact the
+    changed placements for the one sanctioned fetch.
+
+    No rebuild, no cold eps ladder: machine-side routes (``dev.dgen``,
+    the m->sink / rack legs gathered from ``cost_dev``) are the LAST
+    round's prices by design — the periodic correction round re-prices
+    everything and differential-verifies what express placed. The
+    repair reuses the unchanged ``_solve`` kernel, so the exactness
+    certificate gates every batch: converged means the patched
+    instance's optimum, full stop (the gap < scale argument needs no
+    new analysis — scaled costs are multiples of the scale).
+
+    Static args pin one compiled variant per (model, shape bucket,
+    kmax, pk, change_cap) — zero recompiles in steady state.
+    """
+    Tp, Mp = dev.c.shape
+    pos = jnp.arange(Tp, dtype=I32)
+    mids = jnp.arange(Mp, dtype=I32)
+
+    # ---- price the arrivals' task-side arcs (shared cost model) ----
+    cost_mini = model_fn(mini_inputs)
+    u_u = (cost_mini[:kmax]
+           + cost_mini[2 * kmax + kmax * pk: 3 * kmax + kmax * pk])
+    w_u = cost_mini[kmax: 2 * kmax]
+    pc_raw = cost_mini[2 * kmax: 2 * kmax + kmax * pk].reshape(kmax, pk)
+
+    # machine-side legs from the round's priced arc table (same gathers
+    # as _redensify, [Mp]-cheap)
+    def gat(idx, fill):
+        return jnp.where(
+            idx >= 0, cost_dev[jnp.maximum(idx, 0)], jnp.int32(fill)
+        )
+
+    g = gat(dt.arc_m2s, INF)
+    ra_u = jnp.minimum(gat(dt.arc_r2m, INF) + g, INF)
+    scale = dev.scale
+
+    has_pref = (add_pm >= 0) | (add_pr >= 0)
+    pm_leg = jnp.where(add_pm >= 0, g[jnp.maximum(add_pm, 0)], 0)
+    pc_route = jnp.where(
+        has_pref, jnp.minimum(pc_raw + pm_leg, INF), INF
+    )
+
+    # integer-domain guard for the batch (int64 under enable_x64)
+    def finmax(x):
+        return jnp.max(jnp.where(x < INF, x, 0))
+
+    cmax_new = jnp.maximum(
+        jnp.maximum(finmax(u_u), finmax(w_u)), finmax(pc_route)
+    )
+    cmin_new = jnp.minimum(
+        jnp.min(u_u), jnp.minimum(jnp.min(w_u), jnp.min(
+            jnp.where(has_pref, pc_route, 0)
+        ))
+    )
+    domain_ok = (cmin_new >= 0) & (
+        2 * cmax_new.astype(jnp.int64) * scale.astype(jnp.int64)
+        < MAX_SCALED_COST
+    )
+
+    def sc(x):
+        return jnp.where(x >= INF, INF, x * scale).astype(I32)
+
+    u_s, w_s = sc(u_u), sc(w_u)
+    pc_s = sc(pc_route)
+    ra_s = sc(ra_u)
+
+    # ---- build + scatter the arrival rows ----
+    row = jnp.minimum(w_s[:, None] + dev.dgen[None, :], INF)
+    for j in range(pk):
+        pm_j = add_pm[:, j: j + 1]
+        pr_j = add_pr[:, j: j + 1]
+        pc_j = pc_s[:, j: j + 1]
+        hit_m = (pm_j == mids[None, :]) & (pm_j >= 0)
+        row = jnp.minimum(row, jnp.where(hit_m, pc_j, INF))
+        hit_r = (pr_j == dt.rack_of[None, :]) & (pr_j >= 0)
+        row = jnp.minimum(
+            row,
+            jnp.where(hit_r, jnp.minimum(pc_j + ra_s[None, :], INF),
+                      INF),
+        )
+    row = jnp.where(dev.s[None, :] > 0, row, INF)
+
+    addi = jnp.where(add_row >= 0, add_row, Tp)
+    c2 = dev.c.at[addi].set(row, mode="drop")
+    u2 = dev.u.at[addi].set(u_s, mode="drop")
+    w2 = dev.w.at[addi].set(w_s, mode="drop")
+    valid2 = dev.task_valid.at[addi].set(True, mode="drop")
+    asg0 = asg.at[addi].set(-1, mode="drop")
+    lvl0 = lvl.at[addi].set(0, mode="drop")
+    dev2 = DenseInstance(
+        c=c2, u=u2, w=w2, dgen=dev.dgen, s=dev.s,
+        task_valid=valid2, scale=dev.scale, cmax=dev.cmax, smax=smax,
+    )
+
+    # ---- bounded eps=1 repair from the existing prices ----
+    asg_f, lvl_f, floor_f, gap, conv, rounds, phases, _ = _solve(
+        dev2, asg0, lvl0, floor, jnp.int32(1), alpha=alpha,
+        max_rounds=max_rounds, smax=smax, analytic_init=False,
+    )
+
+    # ---- compact ONLY the affected placements for the fetch ----
+    report = valid2 & (asg_f >= 0) & (asg_f < Mp) & (asg_f != asg0)
+    n_changes = jnp.sum(report, dtype=I32)
+    key = jax.lax.sort(jnp.where(report, pos, Tp))
+    rows_out = key[:change_cap]
+    asg_out = jnp.where(
+        rows_out < Tp, asg_f[jnp.minimum(rows_out, Tp - 1)], -1
+    )
+
+    # exact objective of the active rows (the express cost, scaled)
+    on_m = (asg_f >= 0) & (asg_f < Mp)
+    c_asg = jnp.take_along_axis(
+        c2, jnp.clip(asg_f, 0, Mp - 1)[:, None], axis=1
+    )[:, 0]
+    per = jnp.where(
+        valid2, jnp.where(on_m, c_asg, jnp.where(asg_f == Mp, u2, INF)),
+        0,
+    )
+    primal = jnp.sum(per.astype(jnp.int64))
+    n_active = jnp.sum(valid2, dtype=I32)
+
+    return (dev2, asg_f, lvl_f, floor_f, gap, conv, rounds, phases,
+            rows_out, asg_out, n_changes, domain_ok, primal, n_active)
+
+
 _MODEL_JIT_CACHE: dict[object, object] = {}
 
 
@@ -386,9 +597,13 @@ def _resident_chain(
     # flat tuple out (DenseState is not a registered pytree); the
     # caller reassembles the warm handle host-side. ``cost`` rides
     # along so oracle-fallback paths reuse the priced arc table
-    # instead of re-running the model as a separate program.
+    # instead of re-running the model as a separate program, and
+    # ``dev`` (the densified on-HBM instance — its arrays are aliases
+    # of buffers the program produced anyway) rides along so the
+    # express lane can keep the warm table resident and patch it in
+    # place between rounds instead of re-densifying.
     return (asg, lvl, floor, gap, converged, rounds, phases, ch,
-            primal, domain_ok, cost)
+            primal, domain_ok, cost, dev)
 
 
 @dataclasses.dataclass
@@ -425,6 +640,9 @@ class InflightSolve:
     future: object = None            # Future -> fetched host tuple
     state: object = None             # device DenseState (warm candidate)
     cost_dev: object = None          # priced arc table (oracle fallback)
+    dev: object = None               # device DenseInstance (express lane)
+    machine_kwargs: dict | None = None  # host machine-side cost inputs
+                                        # (express mini-pricing reuse)
     arrays: dict | None = None
     meta: GraphMeta | None = None
     topo: TransportTopology | None = None
@@ -451,6 +669,114 @@ class InflightSolve:
     consumed: bool = False
 
 
+@dataclasses.dataclass(frozen=True)
+class ExpressArrival:
+    """One new pending pod for the express lane, in builder-column
+    vocabulary: ``prefs`` are the (machine_idx, rack_idx, weight) rows
+    ``FlowGraphBuilder.task_arc_rows`` resolves — the SAME single-event
+    column patch the incremental builder applies, so the periodic
+    correction round builds an identical graph for this pod."""
+
+    uid: str
+    wait_rounds: int = 0
+    cpu_milli: int = 0
+    mem_kb: int = 0
+    prefs: tuple = ()    # ((machine_idx | -1, rack_idx | -1, weight), ...)
+
+
+@dataclasses.dataclass
+class ExpressBatch:
+    """One coalesced watch-event batch for ``express_round``.
+
+    ``retires`` are pods whose binding POST landed since the last
+    dispatch (row deactivates, target machine's capacity drops one);
+    ``removals`` are pending pods that left the cluster; ``slot_deltas``
+    are bare capacity changes (a running pod completing frees a seat)."""
+
+    arrivals: list[ExpressArrival] = dataclasses.field(
+        default_factory=list)
+    retires: list[tuple[str, str]] = dataclasses.field(
+        default_factory=list)      # (uid, machine name)
+    removals: list[str] = dataclasses.field(default_factory=list)
+    slot_deltas: list[tuple[str, int]] = dataclasses.field(
+        default_factory=list)      # (machine name, +/- seats)
+
+
+@dataclasses.dataclass
+class ExpressOutcome:
+    """One express dispatch's result. ``ok=False`` means the batch
+    DEGRADED (reason says why): nothing was placed, the express context
+    is invalidated, and the events simply wait for the next full round
+    — never a silent wrong placement (the in-kernel certificate gates
+    every batch)."""
+
+    ok: bool
+    placements: list[tuple[str, str]] = dataclasses.field(
+        default_factory=list)      # (uid, machine name)
+    cost: int = 0
+    rounds: int = 0
+    reason: str = ""
+    timings: dict = dataclasses.field(default_factory=dict)
+
+
+class ExpressDegrade(Exception):
+    """This batch cannot take the express path. Raised internally by
+    the patch/repair chain (``express_round`` turns it into an
+    ``ExpressOutcome(ok=False)``) and by ``express_maps`` when
+    finalizing the context degrades (the bridge invalidates + counts)."""
+
+
+@dataclasses.dataclass
+class _ExpressContext:
+    """The warm on-HBM state the express lane patches between rounds.
+
+    Created by ``finish_round`` on every certified dense round (express
+    lane on), dropped by the next ``begin_round``. Device handles keep
+    the round's densified table / topology / priced arcs resident; the
+    host maps are built LAZILY on first express use so rounds that see
+    no inter-round events pay nothing beyond the references.
+    """
+
+    dev: object                 # device DenseInstance (the warm table)
+    dt: object                  # device DenseTopology
+    cost_dev: object            # device priced arc table (round prices)
+    meta: object                # GraphMeta of the round's build
+    topo: object                # base TransportTopology
+    agg_plan: object            # AggregatePlan | None
+    assignment: np.ndarray      # round's final base-machine assignment
+    machine_kwargs: dict        # host machine-side cost inputs (stale
+                                # by design: "from the existing prices")
+    model_fn: object
+    n_prefs: int
+    smax: int
+    Tp: int
+    Mp: int                     # solve-axis width (columns under agg)
+    T: int
+    scale: int
+    # ---- lazy host maps (built on first express dispatch) ----
+    ready: bool = False
+    uid_row: dict | None = None
+    row_uid: dict | None = None
+    free_rows: list | None = None
+    midx: dict | None = None
+    rack_idx: dict | None = None
+    # rebalancing mode: running rows frozen out of the express auction
+    # (their seats become used capacity), applied with the first batch
+    pending_freeze: tuple | None = None
+    col_of: np.ndarray | None = None
+    col_bounds: np.ndarray | None = None
+    col_order: np.ndarray | None = None
+    members_per_col: np.ndarray | None = None
+    member_slots_left: np.ndarray | None = None
+    batches: int = 0
+
+
+# chunk width for the retire/slot patch kernel: backlogs larger than
+# one chunk (a big round's bindings, a preemption-mode freeze of every
+# running row) apply as several cheap scatter dispatches
+_EXPRESS_PATCH_CHUNK = 1024
+
+
 class ResidentSolver:
     """Owns the device-resident solve chain + warm state across rounds.
 
@@ -473,6 +799,9 @@ class ResidentSolver:
         mesh_width: int = 0,
         aggregate_classes: bool = False,
         topk_prefs: int = 0,
+        express_lane: bool = False,
+        express_max_batch: int = 16,
+        express_change_cap: int = 256,
     ):
         self.alpha = alpha
         self.max_rounds = max_rounds
@@ -516,9 +845,36 @@ class ResidentSolver:
         # asserted by tests/test_guards.py)
         self.fetch_timeouts = 0
         self.last_round_fetches = 0
+        # ---- the express lane (between-rounds fast path) ----
+        # express_lane keeps each certified round's densified table /
+        # topology / prices resident on HBM so small watch-event
+        # batches re-solve in ONE fused dispatch + ONE sanctioned
+        # fetch (express_round); express_max_batch bounds arrivals per
+        # dispatch (a static shape: one compiled variant), and
+        # express_change_cap bounds the compacted changed-placement
+        # fetch (more changes than that degrades to a full round)
+        self.express_lane = express_lane
+        self.express_max_batch = express_max_batch
+        self.express_change_cap = express_change_cap
+        self._express: _ExpressContext | None = None
+        # lifetime sanctioned express fetches (one per express batch)
+        self.express_fetches = 0
 
     def reset(self) -> None:
         self._warm = None
+        self._express = None
+
+    @property
+    def express_ready(self) -> bool:
+        """True when a warm express context exists (a certified dense
+        round finished and no full round has begun since)."""
+        return self._express is not None
+
+    def invalidate_express(self) -> None:
+        """Drop the express context: the next batches wait for a full
+        round. Called by the bridge whenever cluster state moves in a
+        way the on-HBM patch vocabulary cannot represent."""
+        self._express = None
 
     @property
     def warm(self) -> DenseState | None:
@@ -578,6 +934,10 @@ class ResidentSolver:
                 "a resident round is already in flight; finish_round() "
                 "must be called before the next begin_round()"
             )
+        # a full round supersedes the inter-round express state; drop
+        # the context FIRST so its HBM (the retained dense table) is
+        # free before this round's chain allocates a fresh one
+        self._express = None
         self.last_round_fetches = 0
         timings: dict[str, float] = {}
         t0 = time.perf_counter()
@@ -766,7 +1126,8 @@ class ResidentSolver:
             t_dispatch = time.perf_counter()
             with enable_x64(True):
                 (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
-                 phases_d, ch_dev, primal, domain_ok, cost_dev) = (
+                 phases_d, ch_dev, primal, domain_ok, cost_dev,
+                 dev_inst) = (
                     _resident_chain(
                         dt, inputs_dev,
                         warm.asg if warm is not None else zeros_t,
@@ -796,6 +1157,12 @@ class ResidentSolver:
             future=_AsyncFetch(_fetch),
             state=state,
             cost_dev=cost_dev,
+            dev=dev_inst,
+            machine_kwargs={
+                k: (cost_input_kwargs or {}).get(k)
+                for k in ("machine_load", "machine_mem_free",
+                          "machine_used_slots")
+            },
             arrays=arrays,
             meta=meta,
             topo=base_topo,
@@ -899,7 +1266,8 @@ class ResidentSolver:
             with no_implicit_transfers():
                 with enable_x64(True):
                     (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
-                     phases_d, ch_dev, primal, _dom, cost_dev) = (
+                     phases_d, ch_dev, primal, _dom, cost_dev,
+                     dev_inst) = (
                         _resident_chain(
                             inflight.dt, inflight.inputs_dev, zeros_t,
                             zeros_t, zeros_m,
@@ -916,6 +1284,7 @@ class ResidentSolver:
                     converged=conv_d, rounds=rounds_d, phases=phases_d,
                 )
             inflight.cost_dev = cost_dev
+            inflight.dev = dev_inst
             self.last_round_fetches += 1
             with sanctioned_transfer():
                 asg_np, ch_np, conv, rounds, phases, primal_np = (
@@ -953,6 +1322,26 @@ class ResidentSolver:
                 (asg >= 0) & (asg < Mp) & (asg < inflight.n_machines),
                 asg, -1,
             ).astype(np.int32)
+        if self.express_lane and inflight.dev is not None:
+            # keep this round's on-HBM instance warm for the express
+            # lane (host maps are built lazily on first express use)
+            self._express = _ExpressContext(
+                dev=inflight.dev,
+                dt=inflight.dt,
+                cost_dev=inflight.cost_dev,
+                meta=inflight.meta,
+                topo=inflight.topo,
+                agg_plan=inflight.agg_plan,
+                assignment=asg,
+                machine_kwargs=inflight.machine_kwargs or {},
+                model_fn=inflight.model_fn,
+                n_prefs=max(inflight.n_prefs, 1),
+                smax=inflight.smax,
+                Tp=inflight.Tp,
+                Mp=Mp,
+                T=T,
+                scale=T + 1,
+            )
         return ResidentOutcome(
             assignment=asg,
             channel=np.asarray(ch_np[:T], np.int32),  # noqa: PTA001 -- already-fetched host data
@@ -965,6 +1354,400 @@ class ResidentSolver:
             timings=timings,
         )
 
+
+    # ---- the express lane ------------------------------------------------
+
+    def _express_finalize(self, ctx: _ExpressContext) -> None:
+        """Build the context's host maps on first express use (off the
+        round's critical path; the one O(T) walk is the uid<->row map a
+        whole inter-round window of batches then shares)."""
+        if ctx.ready:
+            return
+        ctx.uid_row = {
+            u: i for i, u in enumerate(ctx.meta.task_uids)  # noqa: PTA002 -- one-time lazy build per round, amortized over every express batch of the inter-round window (not per-event work)
+        }
+        ctx.row_uid = {i: u for u, i in ctx.uid_row.items()}
+        ctx.free_rows = list(range(ctx.Tp - 1, ctx.T - 1, -1))
+        ctx.midx = {
+            n: i for i, n in enumerate(ctx.meta.machine_names)  # noqa: PTA002 -- same one-time lazy build as uid_row above
+        }
+        ctx.rack_idx = {
+            n: i for i, n in enumerate(ctx.meta.rack_names)
+        }
+        plan = ctx.agg_plan
+        if plan is not None:
+            ctx.col_of = plan.col_of_machine
+            order = np.argsort(plan.col_of_machine, kind="stable")
+            ctx.col_order = order
+            ctx.col_bounds = np.searchsorted(
+                plan.col_of_machine[order],
+                np.arange(plan.n_cols + 1),
+            )
+            ctx.members_per_col = np.bincount(
+                plan.col_of_machine, minlength=plan.n_cols
+            )
+            # remaining free seats per REAL machine: the round's base
+            # free slots minus its placements (express placements
+            # decrement at report time; completions restore)
+            left = np.asarray(ctx.topo.slots, np.int64).copy()  # noqa: PTA001 -- TransportTopology.slots is host numpy by construction
+            placed = ctx.assignment[ctx.assignment >= 0]
+            left -= np.bincount(placed, minlength=len(left))
+            ctx.member_slots_left = np.maximum(left, 0)
+        # rebalancing mode: running rows are NOT express-movable (rebal
+        # deltas stay round-only), so freeze them — deactivate the row,
+        # turn the seat into used capacity at the machine the round
+        # SEATED it on (its solved assignment; the bridge invalidates
+        # the context whenever actuation diverges from that: failed
+        # migrations, preemptions, deferred deltas)
+        cur = np.asarray(ctx.meta.task_current)  # noqa: PTA001 -- GraphMeta.task_current is host numpy by construction
+        run_rows = np.flatnonzero(cur >= 0)
+        if len(run_rows):
+            tgt = ctx.assignment[run_rows]
+            if (tgt < 0).any():
+                raise ExpressDegrade(
+                    "running task preempted by the round; express "
+                    "waits for the next context"
+                )
+            cols = (
+                ctx.col_of[tgt] if ctx.col_of is not None else tgt
+            ).astype(np.int32)
+            ctx.pending_freeze = (
+                run_rows.astype(np.int32), cols,
+            )
+            for i in run_rows.tolist():  # noqa: PTA002 -- one-time lazy freeze of the running block per round, amortized over the inter-round window
+                u = ctx.row_uid.pop(i, None)
+                if u is not None:
+                    ctx.uid_row.pop(u, None)
+                ctx.free_rows.append(i)
+        ctx.ready = True
+
+    def express_maps(self):
+        """(machine_idx, rack_idx) of the express context's round —
+        what the bridge resolves arrival preference rows against (the
+        builder's ``task_arc_rows`` vocabulary). None when no context
+        is live. Raises ``ExpressDegrade`` when finalizing the context
+        fails (e.g. a running task the round preempted) — the context
+        stays set so the caller's invalidate path counts and traces
+        the degrade before dropping it."""
+        ctx = self._express
+        if ctx is None:
+            return None
+        self._express_finalize(ctx)
+        return ctx.midx, ctx.rack_idx
+
+    def _express_col(self, ctx: _ExpressContext, machine_idx: int) -> int:
+        return (
+            int(ctx.col_of[machine_idx]) if ctx.col_of is not None
+            else machine_idx
+        )
+
+    def _express_member(self, ctx: _ExpressContext, col: int) -> str:
+        """Expand a winning solve column to a real machine name
+        (class -> first member with a free seat, canonical order —
+        the express analog of ``expand_assignment``'s fill pass)."""
+        if ctx.agg_plan is None:
+            if col >= len(ctx.meta.machine_names):
+                raise ExpressDegrade(f"placement on padding col {col}")
+            return ctx.meta.machine_names[col]
+        lo, hi = ctx.col_bounds[col], ctx.col_bounds[col + 1]
+        members = ctx.col_order[lo:hi]
+        avail = ctx.member_slots_left[members] > 0
+        if not avail.any():
+            raise ExpressDegrade(f"class {col} overfull on expansion")
+        m = int(members[int(np.argmax(avail))])
+        ctx.member_slots_left[m] -= 1
+        return ctx.meta.machine_names[m]
+
+    def _express_mini_inputs(
+        self, ctx: _ExpressContext, arrivals: list[ExpressArrival],
+        kmax: int, pk: int,
+    ):
+        """Host CostInputs for the arrivals' task-side arcs: a mini arc
+        table (unsched + cluster + pref + unsched->sink per slot) fed
+        through ``build_cost_inputs_host`` with the ROUND's machine
+        aggregates, so express pricing is the same registry model over
+        the same input construction as the full round."""
+        E = kmax * (3 + pk)
+        kind = np.full(E, -1, np.int8)
+        a_task = np.zeros(E, np.int32)
+        a_machine = np.full(E, -1, np.int32)
+        a_weight = np.zeros(E, np.int32)
+        ks = np.arange(kmax, dtype=np.int32)
+        kind[:kmax] = int(ArcKind.TASK_TO_UNSCHED)
+        kind[kmax: 2 * kmax] = int(ArcKind.TASK_TO_CLUSTER)
+        u2s = 2 * kmax + kmax * pk
+        kind[u2s: u2s + kmax] = int(ArcKind.UNSCHED_TO_SINK)
+        a_task[:kmax] = ks
+        a_task[kmax: 2 * kmax] = ks
+        a_task[u2s: u2s + kmax] = ks
+        wait = np.zeros(kmax, np.int32)
+        cpu = np.zeros(kmax, np.int64)
+        mem = np.zeros(kmax, np.int64)
+        uids = [""] * kmax
+        for k, a in enumerate(arrivals):
+            uids[k] = a.uid
+            wait[k] = a.wait_rounds
+            cpu[k] = a.cpu_milli
+            mem[k] = a.mem_kb
+            for j, (m, _r, wgt) in enumerate(a.prefs):
+                i = 2 * kmax + k * pk + j
+                kind[i] = int(
+                    ArcKind.TASK_TO_MACHINE if m >= 0
+                    else ArcKind.TASK_TO_RACK
+                )
+                a_task[i] = k
+                a_machine[i] = m
+                a_weight[i] = wgt
+        zero = np.zeros(0, np.int32)
+        mini_meta = GraphMeta(
+            node_role=np.zeros(0, np.int8),
+            arc_kind=kind,
+            arc_task=a_task,
+            arc_machine=a_machine,
+            arc_rack=np.full(E, -1, np.int32),
+            arc_weight=a_weight,
+            arc_discount=np.zeros(E, np.int32),
+            task_wait=wait,
+            task_current=np.full(kmax, -1, np.int32),
+            task_node=zero,
+            machine_node=zero,
+            node_machine=zero,
+            task_uids=uids,
+            machine_names=ctx.meta.machine_names,
+            rack_names=[],
+            job_ids=[],
+            n_nodes=0,
+            n_arcs=E,
+        )
+        kw = {
+            k: v for k, v in ctx.machine_kwargs.items() if v is not None
+        }
+        return build_cost_inputs_host(
+            E, mini_meta, task_cpu_milli=cpu, task_mem_kb=mem, **kw
+        )
+
+    def _express_put(self, tree):
+        """One batched upload of host express inputs (replicated over
+        the mesh in the sharded lane)."""
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            return jax.device_put(
+                tree, jax.tree_util.tree_map(lambda _: repl, tree)
+            )
+        return jax.device_put(tree)
+
+    def express_round(self, batch: ExpressBatch) -> ExpressOutcome:
+        """Turn one coalesced watch-event batch into bindings WITHOUT a
+        round: patch the warm on-HBM instance (retire bound rows,
+        adjust slot capacities, activate+price arrival rows) and run
+        the bounded eps=1 repair as ONE fused dispatch with ONE
+        sanctioned fetch of only the affected placements.
+
+        Degrades loudly (``ok=False`` + the context invalidated) on
+        anything the patch vocabulary cannot represent or the
+        certificate cannot prove — the events then simply wait for the
+        next full round. Never raises for a representational miss.
+        """
+        ctx = self._express
+        if ctx is None:
+            return ExpressOutcome(ok=False, reason="no-context")
+        if self._inflight:
+            return ExpressOutcome(ok=False, reason="round-in-flight")
+        timings: dict[str, float] = {}
+        t0 = time.perf_counter()
+        try:
+            self._express_finalize(ctx)
+            kmax = self.express_max_batch
+            pk = ctx.n_prefs
+            arrivals = batch.arrivals
+            if len(arrivals) > kmax:
+                raise ExpressDegrade(
+                    f"{len(arrivals)} arrivals > --express_max_batch "
+                    f"{kmax}"
+                )
+            # ---- map retires / removals / slot deltas to patches ----
+            rows: list[int] = []
+            cols: list[int] = []
+            deltas: list[int] = []
+            if ctx.pending_freeze is not None:
+                # first batch of a rebalancing-mode window: freeze the
+                # running block out of the express auction
+                fr, fc = ctx.pending_freeze
+                rows.extend(fr.tolist())
+                cols.extend(fc.tolist())
+                deltas.extend([-1] * len(fr))
+                ctx.pending_freeze = None
+            for uid, mname in batch.retires:
+                r = ctx.uid_row.pop(uid, None)
+                if r is None:
+                    raise ExpressDegrade(f"retire of unknown {uid}")
+                ctx.row_uid.pop(r, None)
+                ctx.free_rows.append(r)
+                m = ctx.midx.get(mname)
+                if m is None:
+                    raise ExpressDegrade(
+                        f"retire on unknown machine {mname}"
+                    )
+                rows.append(r)
+                cols.append(self._express_col(ctx, m))
+                deltas.append(-1)
+            for uid in batch.removals:
+                r = ctx.uid_row.pop(uid, None)
+                if r is None:
+                    raise ExpressDegrade(f"removal of unknown {uid}")
+                ctx.row_uid.pop(r, None)
+                ctx.free_rows.append(r)
+                rows.append(r)
+                cols.append(-1)
+                deltas.append(0)
+            for mname, d in batch.slot_deltas:
+                m = ctx.midx.get(mname)
+                if m is None:
+                    raise ExpressDegrade(
+                        f"slot delta on unknown machine {mname}"
+                    )
+                rows.append(-1)
+                cols.append(self._express_col(ctx, m))
+                deltas.append(d)
+                if ctx.member_slots_left is not None:
+                    ctx.member_slots_left[m] = max(
+                        ctx.member_slots_left[m] + d, 0
+                    )
+            # ---- map arrivals to rows + solve-space pref targets ----
+            add_row = np.full(kmax, -1, np.int32)
+            add_pm = np.full((kmax, pk), -1, np.int32)
+            add_pr = np.full((kmax, pk), -1, np.int32)
+            for k, a in enumerate(arrivals):
+                if a.uid in ctx.uid_row:
+                    raise ExpressDegrade(f"duplicate arrival {a.uid}")
+                if len(a.prefs) > pk:
+                    raise ExpressDegrade(
+                        f"{a.uid} has {len(a.prefs)} prefs > the "
+                        f"round's pref width {pk}"
+                    )
+                if not ctx.free_rows:
+                    raise ExpressDegrade(
+                        "padded task rows exhausted (cluster outgrew "
+                        "the round's bucket)"
+                    )
+                r = ctx.free_rows.pop()
+                ctx.uid_row[a.uid] = r
+                ctx.row_uid[r] = a.uid
+                add_row[k] = r
+                for j, (m, rk, _w) in enumerate(a.prefs):
+                    if m >= 0:
+                        col = self._express_col(ctx, m)
+                        if (ctx.members_per_col is not None
+                                and ctx.members_per_col[col] != 1):
+                            raise ExpressDegrade(
+                                f"{a.uid} prefers machine {m} inside "
+                                f"a non-singleton class (not pinned "
+                                f"at the last round)"
+                            )
+                        add_pm[k, j] = col
+                    else:
+                        add_pr[k, j] = rk
+            mini_host = self._express_mini_inputs(
+                ctx, arrivals, kmax, pk
+            )
+            timings["prep_ms"] = (time.perf_counter() - t0) * 1000
+
+            # ---- one batched upload + patch chunks + fused repair ----
+            warm = self._warm
+            if warm is None:
+                raise ExpressDegrade("no warm state")
+            t0u = time.perf_counter()
+            with no_implicit_transfers():
+                mini_dev, add_row_d, add_pm_d, add_pr_d, patch_dev = (
+                    self._express_put((
+                        mini_host, add_row, add_pm, add_pr,
+                        _express_patch_chunks(rows, cols, deltas),
+                    ))
+                )
+                timings["upload_ms"] = (
+                    time.perf_counter() - t0u
+                ) * 1000
+                t_dispatch = time.perf_counter()
+                dev = ctx.dev
+                asg, lvl, floor = warm.asg, warm.lvl, warm.floor
+                u_d, w_d, valid_d, s_d = (
+                    dev.u, dev.w, dev.task_valid, dev.s
+                )
+                for rows_d, cols_d, deltas_d in patch_dev:
+                    u_d, w_d, valid_d, s_d, asg, lvl = _express_patch(
+                        u_d, w_d, valid_d, s_d, asg, lvl,
+                        rows_d, cols_d, deltas_d,
+                    )
+                dev = DenseInstance(
+                    c=dev.c, u=u_d, w=w_d, dgen=dev.dgen, s=s_d,
+                    task_valid=valid_d, scale=dev.scale, cmax=dev.cmax,
+                    smax=dev.smax,
+                )
+                with enable_x64(True):
+                    (dev2, asg_f, lvl_f, floor_f, gap, conv, rounds_d,
+                     phases, rows_out, asg_out, n_changes, domain_ok,
+                     primal, n_active) = _express_chain(
+                        dev, ctx.dt, ctx.cost_dev, mini_dev,
+                        asg, lvl, floor,
+                        add_row_d, add_pm_d, add_pr_d,
+                        model_fn=ctx.model_fn, kmax=kmax, pk=pk,
+                        alpha=self.alpha, max_rounds=EXPRESS_FUSE,
+                        smax=ctx.smax,
+                        change_cap=self.express_change_cap,
+                    )
+            self.express_fetches += 1
+            with sanctioned_transfer():
+                (rows_np, asg_np, n_chg, conv_np, dom_np, rnds_np,
+                 primal_np) = jax.device_get((  # noqa: PTA001 -- the express batch's ONE sanctioned fetch: only the affected placements + certificate bits
+                    rows_out, asg_out, n_changes, conv, domain_ok,
+                    rounds_d, primal,
+                ))
+            timings["solve_ms"] = (
+                time.perf_counter() - t_dispatch
+            ) * 1000
+            if not bool(dom_np):
+                raise ExpressDegrade("cost domain exceeded")
+            if not bool(conv_np):
+                raise ExpressDegrade(
+                    f"repair uncertified after {int(rnds_np)} rounds"
+                )
+            if int(n_chg) > self.express_change_cap:
+                raise ExpressDegrade(
+                    f"{int(n_chg)} changed placements > change cap "
+                    f"{self.express_change_cap}"
+                )
+            # ---- commit: the patched instance + repaired state ARE
+            # the warm state the next round/batch starts from ----
+            ctx.dev = dev2
+            ctx.batches += 1
+            self._warm = DenseState(
+                asg=asg_f, lvl=lvl_f, floor=floor_f, gap=gap,
+                converged=conv, rounds=rounds_d, phases=phases,
+            )
+            placements: list[tuple[str, str]] = []
+            for i in range(int(n_chg)):
+                r = int(rows_np[i])
+                uid = ctx.row_uid.get(r)
+                if uid is None:
+                    raise ExpressDegrade(
+                        f"placement on unmapped row {r}"
+                    )
+                placements.append(
+                    (uid, self._express_member(ctx, int(asg_np[i])))
+                )
+            return ExpressOutcome(
+                ok=True,
+                placements=placements,
+                cost=int(primal_np) // ctx.scale,
+                rounds=int(rnds_np),
+                timings=timings,
+            )
+        except ExpressDegrade as e:
+            self._express = None
+            return ExpressOutcome(ok=False, reason=str(e),
+                                  timings=timings)
 
     def _oracle_round(
         self, arrays, meta, topo, cost_dev, timings, *, why: str

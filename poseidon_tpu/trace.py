@@ -17,7 +17,13 @@ reconnect, ``detail.resource``/``detail.reason``), FETCH_TIMEOUT
 ``--max_solver_runtime`` deadline; the round is abandoned loudly) and
 DEGRADE (the dense lane fell back to the CPU oracle this round —
 ``detail.why`` names the guard: memory-envelope, cost-domain, or
-uncertified; counted in ``SchedulerStats.degrades_total``),
+uncertified; counted in ``SchedulerStats.degrades_total``). The
+express lane (``--express_lane``) adds EXPRESS_PLACE (a pod bound
+between round ticks by the on-HBM incremental re-solve),
+EXPRESS_CORRECTED (the periodic correction round moved an express
+placement — the differential-verify outcome), and EXPRESS_DEGRADE (an
+express batch fell back to the round path, ``detail.why`` names the
+guard that fired),
 plus ROUND records carrying the per-phase timing/stat payload
 (``SchedulerStats`` as a dict — including the round-pipeline timers:
 ``build_mode`` delta/full/legacy, ``dispatch_ms``, ``fetch_wait_ms``,
@@ -56,6 +62,9 @@ EVENT_TYPES = frozenset({
     "WATCH_RECONNECT",  # error-path watch-stream reconnect
     "FETCH_TIMEOUT",    # pipelined placement fetch missed its deadline
     "DEGRADE",          # dense lane degraded this round to the oracle
+    "EXPRESS_PLACE",    # express-lane placement between round ticks
+    "EXPRESS_CORRECTED",  # correction round moved an express placement
+    "EXPRESS_DEGRADE",  # express batch fell back to the round path
 })
 
 

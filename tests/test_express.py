@@ -1,0 +1,596 @@
+"""The express lane: between-ticks event-to-bind fast path.
+
+Covers the whole vertical: on-HBM patch + bounded eps=1 repair
+(``ResidentSolver.express_round``), the bridge's batch path with its
+before/after coalescing (``SchedulerBridge.express_batch``), the
+differential contract against the next full (correction) round, flag-
+off bit-identity, composition with the scale lane
+(``--aggregate_classes`` / ``--mesh_width`` — the mesh-8 cases run as
+real SPMD programs on the conftest-forced 8-virtual-device platform),
+the zero steady-state recompile budget, and the watch-driven window
+(``ClusterWatcher.express_poll``) end to end through the cli loop.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.cluster import Task, TaskPhase
+from poseidon_tpu.guards import CompileCounter
+from poseidon_tpu.synth import make_synthetic_cluster
+from poseidon_tpu.trace import TraceGenerator
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def make_bridge(n_machines=20, n_tasks=90, seed=3, *, trace=None,
+                run_first_round=True, confirm=True, **kw):
+    """A bridge on the dense lane with one certified round behind it
+    (the express context's precondition), plus its cluster."""
+    cluster = make_synthetic_cluster(
+        n_machines, n_tasks, seed=seed, prefs_per_task=2,
+        **({"running_fraction": kw.pop("running_fraction")}
+           if "running_fraction" in kw else {}),
+    )
+    bridge = SchedulerBridge(
+        cost_model="quincy", small_to_oracle=False, express_lane=True,
+        trace=trace, **kw,
+    )
+    bridge.observe_nodes(list(cluster.machines))
+    bridge.observe_pods(list(cluster.tasks))
+    if run_first_round:
+        res = bridge.run_scheduler()
+        if confirm:
+            for uid, m in res.bindings.items():
+                bridge.confirm_binding(uid, m)
+    return bridge, cluster
+
+
+def arrival(uid, cluster=None, k=0, cpu=0.2, mem=256):
+    prefs = {}
+    if cluster is not None:
+        prefs = {cluster.machines[k % len(cluster.machines)].name: 400}
+    return Task(uid=uid, cpu_request=cpu, memory_request_kb=mem,
+                data_prefs=prefs)
+
+
+class TestExpressBasics:
+    def test_arrival_binds_between_rounds(self):
+        trace = TraceGenerator()
+        bridge, cluster = make_bridge(trace=trace)
+        assert bridge.solver.express_ready
+        t0 = time.perf_counter()
+        r = bridge.express_batch(
+            [("ADDED", arrival("xp-0", cluster))], t_event=t0
+        )
+        assert r is not None and list(r.bindings) == ["xp-0"]
+        assert r.latency_ms > 0
+        assert "EXPRESS_PLACE" in {e.event for e in trace.events}
+        bridge.confirm_binding("xp-0", r.bindings["xp-0"])
+        stats = bridge.run_scheduler().stats
+        assert stats.express_batches == 1
+        assert stats.express_places == 1
+        assert stats.express_degrades == 0
+        assert stats.express_e2b_p50_ms > 0
+        assert stats.express_e2b_p99_ms >= stats.express_e2b_p50_ms
+
+    def test_no_context_applies_events_and_waits(self):
+        bridge, cluster = make_bridge(run_first_round=False)
+        assert not bridge.solver.express_ready
+        r = bridge.express_batch([("ADDED", arrival("xp-0", cluster))])
+        assert r is None
+        # the event was still applied: the round places the pod
+        res = bridge.run_scheduler()
+        assert "xp-0" in res.bindings
+
+    def test_completion_frees_seat_no_placement(self):
+        bridge, cluster = make_bridge(running_fraction=0.3)
+        run = next(t for t in bridge.tasks.values()
+                   if t.phase == TaskPhase.RUNNING)
+        r = bridge.express_batch([("DELETED", run)])
+        # a pure completion batch patches capacity; nothing to bind
+        assert r is None or r.bindings == {}
+        assert run.uid not in bridge.tasks
+
+    def test_oversize_batch_degrades_loudly(self):
+        bridge, cluster = make_bridge(express_max_batch=4)
+        pods = [arrival(f"xp-{k}", cluster, k) for k in range(6)]
+        r = bridge.express_batch([("ADDED", p) for p in pods])
+        assert r is None
+        assert not bridge.solver.express_ready
+        res = bridge.run_scheduler()
+        assert res.stats.express_degrades == 1
+        # the degraded events still reached bridge state via the round
+        assert all(f"xp-{k}" in res.bindings for k in range(6))
+
+    def test_adoption_outside_vocabulary_degrades(self):
+        bridge, cluster = make_bridge()
+        adopted = Task(uid="adopted-0", phase=TaskPhase.RUNNING,
+                       machine=cluster.machines[0].name)
+        r = bridge.express_batch([("ADDED", adopted)])
+        assert r is None
+        assert not bridge.solver.express_ready
+        assert bridge.run_scheduler().stats.express_degrades == 1
+
+    def test_unconfirmed_placement_blocks_next_batch(self):
+        bridge, cluster = make_bridge()
+        r = bridge.express_batch([("ADDED", arrival("xp-0", cluster))])
+        assert r is not None and r.bindings
+        # no confirm_binding: the POST is still on the wire
+        r2 = bridge.express_batch([("ADDED", arrival("xp-1", cluster))])
+        assert r2 is None
+        res = bridge.run_scheduler()
+        assert res.stats.express_degrades == 1
+        # both pods end up placed by the round path regardless
+        assert "xp-1" in res.bindings
+
+    def test_node_event_invalidates_context(self):
+        bridge, cluster = make_bridge()
+        assert bridge.solver.express_ready
+        bridge.observe_node_event("DELETED", cluster.machines[-1])
+        assert not bridge.solver.express_ready
+
+    def test_revoked_binding_invalidates_context(self):
+        bridge, cluster = make_bridge()
+        r = bridge.express_batch([("ADDED", arrival("xp-0", cluster))])
+        assert r is not None and r.bindings
+        bridge.binding_failed("xp-0")
+        assert not bridge.solver.express_ready
+
+
+class TestCoalesce:
+    """Regression (satellite): duplicate watch events for one pod uid
+    within one express batch must coalesce — double-apply protection at
+    batch granularity, mirroring the per-stream rv guard."""
+
+    def test_duplicate_added_coalesces_to_one_row(self):
+        bridge, cluster = make_bridge()
+        pod = arrival("dup-0", cluster)
+        r = bridge.express_batch([("ADDED", pod), ("ADDED", pod),
+                                  ("MODIFIED", pod)])
+        assert r is not None
+        assert list(r.bindings) == ["dup-0"]
+        bridge.confirm_binding("dup-0", r.bindings["dup-0"])
+        stats = bridge.run_scheduler().stats
+        assert stats.express_places == 1
+        assert stats.express_degrades == 0
+
+    def test_added_then_deleted_is_net_noop(self):
+        bridge, cluster = make_bridge()
+        # flush the first round's retire backlog so the noop batch
+        # below has genuinely nothing to dispatch
+        bridge.express_batch([])
+        pod = arrival("flash-0", cluster)
+        r = bridge.express_batch([("ADDED", pod), ("DELETED", pod)])
+        assert r is None  # nothing to dispatch: pure replay noise
+        assert bridge.solver.express_ready  # and no degrade either
+        assert "flash-0" not in bridge.tasks
+        assert bridge.run_scheduler().stats.express_degrades == 0
+
+    def test_replayed_arrival_across_batches_is_noop(self):
+        bridge, cluster = make_bridge()
+        pod = arrival("rep-0", cluster)
+        r = bridge.express_batch([("ADDED", pod)])
+        assert r is not None and r.bindings
+        bridge.confirm_binding("rep-0", r.bindings["rep-0"])
+        # the stream replays the stale PENDING event for the now-
+        # locally-confirmed pod: the bridge's poll-latency guard keeps
+        # it RUNNING, the before/after diff is a noop, and the device
+        # row is NOT double-applied (no degrade either)
+        # (the dispatch, if any, carries only rep-0's own retire)
+        r2 = bridge.express_batch([("ADDED", pod)])
+        assert r2 is None or r2.bindings == {}
+        assert bridge.solver.express_ready
+        assert bridge.pod_to_machine.get("rep-0") is not None
+
+
+class TestDifferential:
+    """The tentpole harness: every express placement either equals what
+    the next full round would have chosen, or is corrected by that
+    round (counted + traced) under the hysteresis bound."""
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_express_equals_next_round_choice(self, seed):
+        # unconfirmed express placements leave the pods PENDING, so the
+        # next round re-solves them from scratch on the full rebuilt
+        # graph: the express choice must match per uid (same columns —
+        # the shared task_arc_rows patch — same prices, same auction)
+        bridge, cluster = make_bridge(seed=seed)
+        rng = np.random.default_rng(seed)
+        pods = [
+            arrival(f"xp-{seed}-{k}", cluster, int(rng.integers(20)),
+                    cpu=float(rng.choice([0.1, 0.2, 0.4])))
+            for k in range(5)
+        ]
+        r = bridge.express_batch([("ADDED", p) for p in pods])
+        assert r is not None and len(r.bindings) >= 1
+        res = bridge.run_scheduler()
+        for uid, machine in r.bindings.items():
+            assert res.bindings.get(uid) == machine, (
+                f"express placed {uid} on {machine}, the full round "
+                f"chose {res.bindings.get(uid)}"
+            )
+
+    @pytest.mark.parametrize("preemption", [False, True])
+    def test_churn_mix_fuzz(self, preemption):
+        # arrivals + pending removals + completions across several
+        # windows, confirmed bindings, correction round after each:
+        # accounting must balance and every window's placements must
+        # be either left in place or counted as corrected
+        kw = dict(enable_preemption=True, migration_hysteresis=5,
+                  running_fraction=0.25) if preemption else {}
+        bridge, cluster = make_bridge(n_machines=16, n_tasks=80,
+                                      seed=29, **kw)
+        rng = np.random.default_rng(29)
+        n_new = 0
+        for window in range(3):
+            events = []
+            for k in range(int(rng.integers(1, 5))):  # arrivals
+                events.append(
+                    ("ADDED", arrival(f"w{window}-{k}", cluster,
+                                      int(rng.integers(16))))
+                )
+                n_new += 1
+            running = [t for t in bridge.tasks.values()
+                       if t.phase == TaskPhase.RUNNING]
+            if running:  # completions
+                events.append(("DELETED", running[
+                    int(rng.integers(len(running)))]))
+            r = bridge.express_batch(events)
+            placed = dict(r.bindings) if r is not None else {}
+            for uid, m in placed.items():
+                bridge.confirm_binding(uid, m)
+            res = bridge.run_scheduler()
+            s = res.stats
+            corrected = {
+                u for u in placed
+                if u in res.migrations or u in res.preemptions
+            }
+            assert s.express_corrected == len(corrected)
+            for uid, m in placed.items():
+                if uid not in corrected:
+                    # verified final under the bound: the round left it
+                    assert bridge.pod_to_machine.get(uid) == m
+            # actuate the correction's deltas so state stays coherent
+            for uid, (_frm, to) in res.migrations.items():
+                bridge.confirm_migration(uid, to)
+            for uid in res.preemptions:
+                bridge.confirm_preemption(uid)
+        # every surviving express pod is placed somewhere real
+        for uid, m in bridge.pod_to_machine.items():
+            assert m in bridge.machines
+
+
+class TestFlagOffBitIdentity:
+    """Satellite: with --express_lane off the rounds are bit-identical
+    to a bridge that has the lane on but never uses it (the flag adds
+    guards, never behavior, to the round path)."""
+
+    def test_rounds_identical_with_and_without_flag(self):
+        results = []
+        for lane in (False, True):
+            cluster = make_synthetic_cluster(18, 70, seed=41,
+                                             prefs_per_task=2)
+            bridge = SchedulerBridge(
+                cost_model="quincy", small_to_oracle=False,
+                express_lane=lane,
+            )
+            bridge.observe_nodes(list(cluster.machines))
+            bridge.observe_pods(list(cluster.tasks))
+            rounds = []
+            for n in range(3):
+                res = bridge.run_scheduler()
+                for uid, m in res.bindings.items():
+                    bridge.confirm_binding(uid, m)
+                rounds.append(
+                    (dict(res.bindings), res.stats.cost,
+                     res.stats.pods_unscheduled)
+                )
+                # tick-path churn between rounds, observe only
+                pod = arrival(f"t{n}", cluster, n)
+                bridge.observe_pod_event("ADDED", pod)
+            results.append(rounds)
+        assert results[0] == results[1]
+
+
+class TestScaleComposition:
+    """Express composes with the PR-6 scale lane: same placements under
+    aggregation and sharding (mesh-8 runs as a real SPMD program on the
+    conftest-forced 8-device platform)."""
+
+    @pytest.mark.parametrize("opts", [
+        {"aggregate_classes": True},
+        {"mesh_width": 1},
+        {"mesh_width": 8},
+        {"mesh_width": 8, "aggregate_classes": True},
+    ])
+    def test_bit_identical_to_plain_lane(self, opts):
+        def drive(**kw):
+            bridge, cluster = make_bridge(n_machines=24, n_tasks=100,
+                                          seed=5, **kw)
+            pods = [arrival(f"xp-{k}", cluster, k) for k in range(4)]
+            r = bridge.express_batch([("ADDED", p) for p in pods])
+            assert r is not None, "express degraded"
+            return dict(r.bindings), r.cost
+
+        assert drive(**opts) == drive()
+
+    def test_aggregated_expansion_respects_capacity(self):
+        # drive enough arrivals through one class that the member fill
+        # has to spill to other members; every placement must land on
+        # a real machine with a real free seat
+        bridge, cluster = make_bridge(
+            n_machines=12, n_tasks=40, seed=17,
+            aggregate_classes=True, max_tasks_per_machine=6,
+        )
+        seats = {
+            m.name: m.max_tasks for m in cluster.machines
+        }
+        for uid, m in bridge.pod_to_machine.items():
+            seats[m] -= 1
+        placed = {}
+        for k in range(8):
+            r = bridge.express_batch([("ADDED", arrival(f"sp-{k}"))])
+            if r is None:
+                break
+            for uid, m in r.bindings.items():
+                placed[uid] = m
+                bridge.confirm_binding(uid, m)
+        for uid, m in placed.items():
+            seats[m] -= 1
+        assert all(v >= 0 for v in seats.values()), seats
+
+
+class TestRecompileBudget:
+    def test_zero_steady_state_recompiles(self):
+        bridge, cluster = make_bridge(n_machines=20, n_tasks=90, seed=7)
+        # warm every express program variant: arrival batch + retire
+        r = bridge.express_batch([("ADDED", arrival("warm-0", cluster))])
+        assert r is not None
+        bridge.confirm_binding("warm-0", r.bindings["warm-0"])
+        r = bridge.express_batch([("ADDED", arrival("warm-1", cluster))])
+        assert r is not None
+        bridge.confirm_binding("warm-1", r.bindings["warm-1"])
+        counter = CompileCounter()
+        with counter:
+            for k in range(4):
+                r = bridge.express_batch(
+                    [("ADDED", arrival(f"st-{k}", cluster, k))]
+                )
+                assert r is not None and r.bindings
+                for uid, m in r.bindings.items():
+                    bridge.confirm_binding(uid, m)
+        if not counter.supported:
+            pytest.skip("this jax exposes no compile-monitoring hook")
+        assert counter.count == 0, (
+            f"{counter.count} steady-state recompile(s) on the "
+            f"express path"
+        )
+
+
+class TestWatchExpressWindow:
+    """ClusterWatcher.express_poll: the between-tick event source."""
+
+    def _server(self, n_nodes=4, n_pods=6):
+        from poseidon_tpu.apiclient import FakeApiServer, K8sApiClient
+
+        server = FakeApiServer().start()
+        for i in range(n_nodes):
+            server.add_node(f"n{i}", cpu="8", memory="16Gi", pods=8)
+        for j in range(n_pods):
+            server.add_pod(f"p{j}", cpu="100m", memory="64Mi")
+        return server, K8sApiClient("127.0.0.1", server.port)
+
+    def test_poll_returns_pod_events_and_tracks_rv(self):
+        from poseidon_tpu.apiclient import ClusterWatcher
+
+        server, client = self._server()
+        watcher = ClusterWatcher(client, max_lag_s=120.0)
+        try:
+            watcher.tick()  # seed
+            server.add_pod("late-0", cpu="100m", memory="64Mi")
+            server.add_pod("late-1", cpu="100m", memory="64Mi")
+            assert watcher.wait_caught_up(server.current_rv(), 10.0)
+            ev = watcher.express_poll(1.0, max_events=8)
+            assert not ev.needs_tick
+            assert [t.uid for _typ, t in ev.pod_events] == [
+                "default/late-0", "default/late-1"
+            ]
+            assert ev.t_first > 0
+            # consumed events never replay into the next tick
+            delta = watcher.tick()
+            assert delta.pod_events == [] and not delta.resynced
+        finally:
+            watcher.stop()
+            server.stop()
+
+    def test_node_event_requests_tick_and_is_not_lost(self):
+        from poseidon_tpu.apiclient import ClusterWatcher
+
+        server, client = self._server()
+        watcher = ClusterWatcher(client, max_lag_s=120.0)
+        try:
+            watcher.tick()
+            server.add_node("n-new", cpu="8", memory="16Gi", pods=8)
+            assert watcher.wait_caught_up(server.current_rv(), 10.0)
+            ev = watcher.express_poll(1.0)
+            assert ev.needs_tick and ev.pod_events == []
+            delta = watcher.tick()
+            assert [m.name for _t, m in delta.node_events] == ["n-new"]
+        finally:
+            watcher.stop()
+            server.stop()
+
+    def test_pod_events_and_needs_tick_in_one_poll(self):
+        # mid-drain degradation: a poll can consume pod events (rv
+        # already advanced past them — tick() would skip them as
+        # replayed history) AND flag needs_tick in the same return.
+        # The caller must apply the consumed events before handing
+        # control to the tick, or they are lost.
+        from poseidon_tpu.apiclient import ClusterWatcher
+
+        server, client = self._server()
+        watcher = ClusterWatcher(client, max_lag_s=120.0)
+        try:
+            watcher.tick()
+            server.add_pod("mid-drain", cpu="100m", memory="64Mi")
+            assert watcher.wait_caught_up(server.current_rv(), 10.0)
+            # queue now holds the pod EVENT; a GONE lands behind it
+            # (as when the stream dies while the batch is draining)
+            watcher._streams["pods"].queue.put(
+                ("GONE", "test: injected mid-drain")
+            )
+            ev = watcher.express_poll(2.0, max_events=8)
+            assert ev.needs_tick
+            assert [t.uid for _typ, t in ev.pod_events] == [
+                "default/mid-drain"
+            ]
+            # the consumed event never replays into the tick's resync
+            # as a pod *event* — only the snapshot diff can recover it
+            delta = watcher.tick()
+            assert all(
+                t.uid != "default/mid-drain"
+                for _typ, t in delta.pod_events
+            )
+        finally:
+            watcher.stop()
+            server.stop()
+
+    def test_gone_stream_requests_tick_resync(self):
+        from poseidon_tpu.apiclient import ClusterWatcher
+
+        server, client = self._server()
+        watcher = ClusterWatcher(client, max_lag_s=120.0)
+        try:
+            watcher.tick()
+            server.add_pod("pre-410", cpu="100m", memory="64Mi")
+            assert watcher.wait_caught_up(server.current_rv(), 10.0)
+            ev = watcher.express_poll(1.0)
+            assert [t.uid for _typ, t in ev.pod_events] == [
+                "default/pre-410"
+            ]
+            # the next reconnects (idle close ~0.25 s) answer 410:
+            # the stream goes GONE and the express window must hand
+            # control back to the tick, whose resync recovers
+            server.gone_next_watch(2)
+            server.add_pod("post-410", cpu="100m", memory="64Mi")
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                ev = watcher.express_poll(0.2)
+                if ev.needs_tick:
+                    break
+            assert ev.needs_tick
+            delta = watcher.tick()
+            assert delta.resynced
+            assert any(t.uid == "default/post-410" for t in delta.pods)
+        finally:
+            watcher.stop()
+            server.stop()
+
+
+class TestExpressCliE2E:
+    """The full daemon loop: watch + express window + correction-round
+    demotion against the fake apiserver, on the dense lane (>64
+    machines so the small-instance oracle routing stays out of the
+    way)."""
+
+    @pytest.mark.slow
+    def test_intertick_arrivals_bind_express(self):
+        import json
+        import tempfile
+
+        from poseidon_tpu.apiclient import FakeApiServer
+        from poseidon_tpu.cli import parse_args, run_loop
+
+        stats_path = tempfile.mktemp(suffix=".jsonl")
+        with FakeApiServer() as server:
+            for i in range(66):
+                server.add_node(f"n{i:03d}", cpu="16", memory="32Gi",
+                                pods=8, rack=f"r{i % 8}")
+            for j in range(90):
+                server.add_pod(f"pod-{j:03d}", cpu="100m",
+                               memory="64Mi", job=f"job{j // 10}")
+
+            def feeder():
+                time.sleep(6.0)  # let round 1 + compiles land
+                for k in range(4):
+                    server.add_pod(f"late-{k}", cpu="100m",
+                                   memory="64Mi")
+                    time.sleep(0.6)
+
+            t = threading.Thread(target=feeder, daemon=True)
+            t.start()
+            rc = run_loop(parse_args([
+                "--k8s_apiserver_host=127.0.0.1",
+                f"--k8s_apiserver_port={server.port}",
+                "--watch=true",
+                "--express_lane=true",
+                "--express_correction_rounds=3",
+                "--flow_scheduling_cost_model=quincy",
+                "--polling_frequency=1500000",
+                "--max_rounds=3",
+                f"--stats_json={stats_path}",
+            ]))
+            t.join()
+            assert rc == 0
+            bound = dict(server.bindings)
+            for k in range(4):
+                assert f"default/late-{k}" in bound
+        rows = [json.loads(line) for line in open(stats_path)]
+        assert sum(r["express_places"] for r in rows) >= 4
+        assert any(r["express_e2b_p50_ms"] > 0 for r in rows)
+
+    @pytest.mark.slow
+    def test_needs_tick_mid_drain_batch_still_binds(self, monkeypatch):
+        # regression: express_poll can return consumed pod events
+        # together with needs_tick (node event / stream death arrived
+        # mid-drain). The window must apply that batch before handing
+        # control to the tick — the shared resourceVersion is already
+        # past the events, so a dropped batch is a pod that never
+        # schedules.
+        from poseidon_tpu.apiclient import FakeApiServer
+        from poseidon_tpu.apiclient.watch import ClusterWatcher
+        from poseidon_tpu.cli import parse_args, run_loop
+
+        orig = ClusterWatcher.express_poll
+        forced: list[bool] = []
+
+        def poll(self, timeout_s, max_events=16):
+            ev = orig(self, timeout_s, max_events=max_events)
+            if ev.pod_events and not forced:
+                forced.append(True)
+                ev.needs_tick = True
+            return ev
+
+        monkeypatch.setattr(ClusterWatcher, "express_poll", poll)
+        with FakeApiServer() as server:
+            for i in range(66):
+                server.add_node(f"n{i:03d}", cpu="16", memory="32Gi",
+                                pods=8, rack=f"r{i % 8}")
+            for j in range(90):
+                server.add_pod(f"pod-{j:03d}", cpu="100m",
+                               memory="64Mi", job=f"job{j // 10}")
+
+            def feeder():
+                time.sleep(6.0)
+                server.add_pod("late-0", cpu="100m", memory="64Mi")
+
+            t = threading.Thread(target=feeder, daemon=True)
+            t.start()
+            rc = run_loop(parse_args([
+                "--k8s_apiserver_host=127.0.0.1",
+                f"--k8s_apiserver_port={server.port}",
+                "--watch=true",
+                "--express_lane=true",
+                "--express_correction_rounds=3",
+                "--flow_scheduling_cost_model=quincy",
+                "--polling_frequency=1500000",
+                "--max_rounds=3",
+            ]))
+            t.join()
+            assert rc == 0
+            assert forced, "the mid-drain needs_tick case never fired"
+            assert "default/late-0" in dict(server.bindings)

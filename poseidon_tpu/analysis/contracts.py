@@ -33,6 +33,33 @@ class ThreadContract:
     handoffs: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
+# the observability recording/span-assembly scopes run INSIDE the
+# round's finish/actuate window and the express fast path: hot under
+# BOTH PTA001 (no host sync) and PTA002 (no O(cluster) walk) from day
+# one — one constant referenced from both maps so the two enforcement
+# surfaces cannot drift apart
+_OBS_HOT_SCOPES = {
+    "poseidon_tpu/obs/metrics.py": (
+        "Counter.inc",
+        "Gauge.set",
+        "Histogram.observe",
+        "SchedulerMetrics.record_round",
+        "SchedulerMetrics.record_degrade",
+        "SchedulerMetrics.record_express_batch",
+        "SchedulerMetrics.record_express_degrade",
+        "SchedulerMetrics.record_resync",
+        "SchedulerMetrics.record_reconnect",
+        "SchedulerMetrics.record_solver_round",
+        "SchedulerMetrics.record_express_fetch",
+    ),
+    "poseidon_tpu/obs/spans.py": (
+        "round_span_tree",
+        "express_span_tree",
+        "emit_span",
+    ),
+}
+
+
 @dataclasses.dataclass(frozen=True)
 class Contracts:
     """The full declared surface consumed by the rules."""
@@ -117,6 +144,10 @@ DEFAULT_CONTRACTS = Contracts(
         "poseidon_tpu/parallel/sharded.py": (
             "resident_round_shardings",
         ),
+        # observability recording + span assembly (_OBS_HOT_SCOPES):
+        # pure host arithmetic on values the caller already fetched,
+        # never a new device sync
+        **_OBS_HOT_SCOPES,
     },
     device_producers=(
         "jnp.",
@@ -166,6 +197,10 @@ DEFAULT_CONTRACTS = Contracts(
             "_plan_from_keys",
             "_pinned_mask",
         ),
+        # metric recording + span assembly (_OBS_HOT_SCOPES): an
+        # O(cluster) walk there would bill every round for its own
+        # observability
+        **_OBS_HOT_SCOPES,
     },
     cluster_sized_names=(
         "tasks",
@@ -193,6 +228,27 @@ DEFAULT_CONTRACTS = Contracts(
                 "_value": "written before _done.set(); read only after "
                           "_done.wait() — Event establishes happens-before",
                 "_exc": "same Event happens-before as _value",
+            },
+        ),
+        # the metrics registry: recording sites run on the driver
+        # thread inside the round, render() on the metrics server's
+        # handler threads — every access to the instrument maps holds
+        # the one shared registry lock
+        "MetricsRegistry": ThreadContract(lock_attr="_lock", handoffs={}),
+        # the /readyz latch: driver-thread marks, handler-thread reads,
+        # both under the lock (the booleans flip once, but reasons()
+        # must not see a torn seeded/round pair)
+        "HealthState": ThreadContract(lock_attr="_lock", handoffs={}),
+        # the endpoint server: started/stopped from the driver thread
+        # only; the serving thread touches the httpd object, never
+        # ObsServer attributes
+        "ObsServer": ThreadContract(
+            lock_attr="_lock",
+            handoffs={
+                "_httpd": "created before Thread.start() and only "
+                          "mutated by start()/stop() on the driver "
+                          "thread; Thread.start() is the happens-"
+                          "before edge for the serving thread",
             },
         ),
         # watch.py's per-resource reader thread

@@ -109,7 +109,10 @@ class ExpressEvents:
     ``ObserveDelta.pod_events`` — the express driver feeds them to
     ``SchedulerBridge.express_batch``. ``t_first`` is the
     ``perf_counter`` stamp at which the first event was dequeued (the
-    event-to-bind latency clock's zero). ``needs_tick=True`` means
+    event-to-bind latency clock's zero); ``t_events`` carries one such
+    dequeue stamp PER event (parallel to ``pod_events``), so the bind
+    path can report a real per-event latency sample instead of
+    replicating the batch's. ``needs_tick=True`` means
     something the express lane must not handle arrived (node events, a
     410/decode degradation, an un-seeded watcher): the driver should
     fall through to a full observe tick, where the normal resync /
@@ -119,6 +122,7 @@ class ExpressEvents:
     pod_events: list[tuple[str, Task]] = dataclasses.field(
         default_factory=list)
     t_first: float = 0.0
+    t_events: list[float] = dataclasses.field(default_factory=list)
     reconnects: int = 0
     needs_tick: bool = False
 
@@ -322,9 +326,14 @@ class ClusterWatcher:
         read_timeout_s: float | None = None,
         backoff_base_s: float = 0.05,
         backoff_cap_s: float = 2.0,
+        metrics=None,
     ):
         self.client = client
         self.trace = trace or TraceGenerator()
+        # observability (obs.SchedulerMetrics or None): resyncs and
+        # reconnects are recorded at their trace-emit sites, on the
+        # caller's thread, from the reason strings already in hand
+        self.metrics = metrics
         self.max_lag_s = max_lag_s
         self.read_timeout_s = (
             read_timeout_s if read_timeout_s is not None
@@ -400,6 +409,8 @@ class ClusterWatcher:
                 self.trace.emit(
                     "WATCH_RESYNC", detail={"reason": reason}
                 )
+                if self.metrics is not None:
+                    self.metrics.record_resync(reason)
                 return ObserveDelta(
                     resynced=True, nodes=nodes, pods=pods, resyncs=1
                 )
@@ -423,6 +434,8 @@ class ClusterWatcher:
                         detail={"resource": resource,
                                 "reason": item[1]},
                     )
+                    if self.metrics is not None:
+                        self.metrics.record_reconnect(resource)
                 elif kind == "BOOKMARK":
                     self._applied_rv[resource] = max(
                         self._applied_rv[resource], item[1]
@@ -476,6 +489,8 @@ class ClusterWatcher:
             self.trace.emit(
                 "WATCH_RESYNC", detail={"reason": resync_reason}
             )
+            if self.metrics is not None:
+                self.metrics.record_resync(resync_reason)
             return ObserveDelta(
                 resynced=True, nodes=nodes, pods=pods,
                 resyncs=1, reconnects=reconnects,
@@ -529,6 +544,8 @@ class ClusterWatcher:
                     "WATCH_RECONNECT",
                     detail={"resource": "nodes", "reason": item[1]},
                 )
+                if self.metrics is not None:
+                    self.metrics.record_reconnect("nodes")
 
     def express_poll(
         self, timeout_s: float, max_events: int = 16
@@ -581,6 +598,8 @@ class ClusterWatcher:
                     "WATCH_RECONNECT",
                     detail={"resource": "pods", "reason": item[1]},
                 )
+                if self.metrics is not None:
+                    self.metrics.record_reconnect("pods")
             elif kind == "BOOKMARK":
                 self._applied_rv["pods"] = max(
                     self._applied_rv["pods"], item[1]
@@ -609,6 +628,7 @@ class ClusterWatcher:
                 if rv:
                     self._applied_rv["pods"] = rv
                 out.pod_events.append((typ, parsed))
+                out.t_events.append(time.perf_counter())
         return out
 
     # ---- test/bench helpers ----

@@ -117,6 +117,11 @@ from poseidon_tpu.ops.resident import (
     InflightSolve,
     ResidentSolver,
 )
+from poseidon_tpu.obs.spans import (
+    emit_span,
+    express_span_tree,
+    round_span_tree,
+)
 from poseidon_tpu.ops.transport import topology_from_columns
 from poseidon_tpu.trace import TraceGenerator
 
@@ -191,6 +196,10 @@ class SchedulerStats:
     express_e2b_p99_ms: float = 0.0
     cost: int = 0
     backend: str = ""
+    # which driver lane produced this round (set by the driver via
+    # ``SchedulerBridge.lane``: poll / watch / +pipelined / express /
+    # +sharded / +agg compositions) — the metrics/report grouping key
+    lane: str = ""
     # host time spent in observe_* (poll snapshot diff or watch event
     # application) since the previous round — the observe phase the
     # per-phase timers were missing (build/price/solve/decompose never
@@ -273,6 +282,8 @@ class SchedulerBridge:
         topk_prefs: int = 0,
         express_lane: bool = False,
         express_max_batch: int = 16,
+        metrics=None,
+        profile_spans: bool = False,
     ):
         self.cost_model = cost_model
         self.max_tasks_per_machine = max_tasks_per_machine
@@ -281,6 +292,17 @@ class SchedulerBridge:
         self.max_migrations_per_round = max_migrations_per_round
         self.express_lane = express_lane
         self.trace = trace or TraceGenerator()
+        # observability: ``metrics`` is an obs.SchedulerMetrics (or
+        # None); recording happens ONLY at finish/actuate time from
+        # host values this class already holds — no new device syncs
+        # (the recording helpers are PTA001/PTA002 scopes).
+        # ``profile_spans`` emits a SPAN trace event per round/express
+        # batch (--trace_profile); ``lane`` is a driver-set label
+        # (poll / watch / +pipelined / express ...) stamped onto each
+        # round's stats for the metrics/report grouping.
+        self.metrics = metrics
+        self.profile_spans = profile_spans
+        self.lane = ""
         self.knowledge = KnowledgeBase(queue_size=sample_queue_size)
         self.machines: dict[str, Machine] = {}
         self.tasks: dict[str, Task] = {}
@@ -299,6 +321,7 @@ class SchedulerBridge:
             topk_prefs=topk_prefs,
             express_lane=express_lane,
             express_max_batch=express_max_batch,
+            metrics=metrics,
         )
         # O(churn) graph maintenance: every state transition below is
         # mirrored as a note; begin_round patches instead of rebuilding
@@ -679,6 +702,8 @@ class SchedulerBridge:
                     detail={"why": why},
                 )
                 self.trace.flush()
+                if self.metrics is not None:
+                    self.metrics.record_express_degrade(why)
 
     def _express_transitions(
         self, before: dict[str, Task | None]
@@ -738,6 +763,7 @@ class SchedulerBridge:
         pod_events: list[tuple[str, Task]],
         *,
         t_event: float | None = None,
+        t_events: list[float] | None = None,
     ) -> ExpressResult | None:
         """The express fast path: apply a small watch-event batch and —
         when the on-HBM context can represent its net effect — turn it
@@ -750,7 +776,10 @@ class SchedulerBridge:
         off, no warm context exists, or the batch degrades — the pods
         then simply wait for the next full round. ``t_event`` (a
         ``perf_counter`` stamp of the earliest event's receipt) feeds
-        the event-to-bind latency accumulator.
+        the event-to-bind latency accumulator; ``t_events`` (parallel
+        to ``pod_events``, watch ``ExpressEvents.t_events``) gives each
+        placement a real per-event sample — without it every placement
+        reports the batch latency measured from ``t_event``.
         """
         t0 = time.perf_counter()
         before: dict[str, Task | None] = {}
@@ -823,12 +852,22 @@ class SchedulerBridge:
                 detail={"why": outcome.reason},
             )
             self.trace.flush()
+            if self.metrics is not None:
+                self.metrics.record_express_degrade(outcome.reason)
             return None
         self._express_batches += 1
         bindings: dict[str, str] = {}
         t_done = time.perf_counter()
         latency = (t_done - (t_event if t_event is not None else t0)) \
             * 1000
+        # per-uid receipt stamps (earliest wins across coalesced
+        # duplicates) so each placement's e2b is ITS latency, not the
+        # batch's replicated onto every event
+        uid_t: dict[str, float] = {}
+        if t_events is not None:
+            for (_typ, pod), ts in zip(pod_events, t_events):
+                uid_t.setdefault(pod.uid, ts)
+        e2b_samples: list[float] = []
         for uid, machine in outcome.placements:
             task = self.tasks.get(uid)
             if (task is None or task.phase != TaskPhase.PENDING
@@ -847,13 +886,30 @@ class SchedulerBridge:
             self.decision_log.append(
                 (self.round_num, "PLACE", uid, machine)
             )
+            e2b = (
+                (t_done - uid_t[uid]) * 1000 if uid in uid_t
+                else latency
+            )
             self.trace.emit(
                 "EXPRESS_PLACE", task=uid, machine=machine,
                 round_num=self.round_num,
+                # per-placement event-to-bind-decision latency (ms,
+                # monotonic-clock difference from the event's OWN
+                # receipt stamp when the driver supplied one)
+                detail={"e2b_ms": round(e2b, 3)},
             )
-            self._express_e2b.append(latency)
+            self._express_e2b.append(e2b)
+            e2b_samples.append(e2b)
         self._express_places += len(bindings)
+        if self.profile_spans:
+            emit_span(
+                self.trace,
+                express_span_tree(latency, outcome.timings),
+                self.round_num,
+            )
         self.trace.flush()
+        if self.metrics is not None:
+            self.metrics.record_express_batch(e2b_samples)
         return ExpressResult(
             bindings=bindings,
             cost=outcome.cost,
@@ -937,6 +993,7 @@ class SchedulerBridge:
             )
         self.round_num += 1
         stats = SchedulerStats(round_num=self.round_num)
+        stats.lane = self.lane
         stats.evictions = self._evictions_this_round
         self._evictions_this_round = 0
         stats.bind_failures = self._bind_failures
@@ -990,6 +1047,10 @@ class SchedulerBridge:
                 detail=dataclasses.asdict(stats),
             )
             self.trace.flush()
+            if self.metrics is not None:
+                # empty rounds still carry the window's counters
+                # (evictions, watch resyncs, express activity)
+                self.metrics.record_round(stats)
             return InflightRound(
                 stats=stats,
                 result=RoundResult(bindings={}, stats=stats,
@@ -1075,6 +1136,9 @@ class SchedulerBridge:
         t_fin = time.perf_counter()
         stats.overlap_ms = (t_fin - ir.t_begin_end) * 1000
 
+        # span stamps on the monotonic clock (trace.py clock contract:
+        # wall time is for timestamps only, never durations)
+        t_join0 = time.monotonic()
         try:
             outcome = self.solver.finish_round(ir.solve)
         except FetchTimeout as e:
@@ -1089,6 +1153,7 @@ class SchedulerBridge:
             )
             self.trace.flush()
             raise
+        t_join1 = time.monotonic()
         meta = ir.meta
         # a finished round replaces the express context: whatever
         # retire backlog / unconfirmed set the OLD window accumulated
@@ -1122,6 +1187,8 @@ class SchedulerBridge:
                     "DEGRADE", round_num=ir.stats.round_num,
                     detail={"why": why, "backend": outcome.backend},
                 )
+                if self.metrics is not None:
+                    self.metrics.record_degrade(why)
         stats.degrades_total = self._degrades_total
 
         # the decision layer: diff the solved assignment against current
@@ -1267,11 +1334,23 @@ class SchedulerBridge:
         t_now = time.perf_counter()
         stats.total_ms = ir.begin_ms + (t_now - t_fin) * 1000
         stats.wall_ms = (t_now - ir.t_begin_start) * 1000
+        if self.profile_spans:
+            emit_span(
+                self.trace,
+                round_span_tree(
+                    stats,
+                    join_ms=(t_join1 - t_join0) * 1000,
+                    actuate_ms=(time.monotonic() - t_join1) * 1000,
+                ),
+                ir.stats.round_num,
+            )
         self.trace.emit(
             "ROUND", round_num=ir.stats.round_num,
             detail=dataclasses.asdict(stats),
         )
         self.trace.flush()
+        if self.metrics is not None:
+            self.metrics.record_round(stats)
         return RoundResult(
             bindings=bindings, stats=stats, unscheduled=unscheduled,
             migrations=migrations, preemptions=preemptions,

@@ -227,6 +227,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace_log", default="",
                    help="append cluster-trace-style scheduler events "
                         "(SUBMIT/SCHEDULE/EVICT/FINISH/ROUND) here")
+    # the operational surface (poseidon_tpu/obs/): a daemon-thread HTTP
+    # server exposing Prometheus metrics + health, and per-phase span
+    # profiling into the trace stream
+    p.add_argument("--metrics_port", type=int, default=0,
+                   help="serve /metrics (Prometheus text format), "
+                        "/healthz (liveness) and /readyz (ready = seed "
+                        "LIST applied + first round over real state done) "
+                        "on "
+                        "this port (0 = disabled)")
+    p.add_argument("--metrics_host", default="0.0.0.0",
+                   help="interface the metrics/health endpoint binds "
+                        "(the endpoint is unauthenticated: bind "
+                        "127.0.0.1 or the pod IP on hosts with "
+                        "untrusted interfaces)")
+    p.add_argument("--trace_profile",
+                   default="false", choices=["true", "false"],
+                   help="emit a SPAN phase-span tree per round and per "
+                        "express batch into the trace stream (inspect "
+                        "with python -m poseidon_tpu.trace report / "
+                        "chrome)")
     return p
 
 
@@ -344,6 +364,28 @@ def run_loop(args: argparse.Namespace) -> int:
 
         trace_fh = open(args.trace_log, "a")
         trace = TraceGenerator(sink=trace_fh)
+    # the observability stack (--metrics_port): metrics registry +
+    # health latch + endpoint server; the bridge/solver/watcher record
+    # into it at finish/actuate time from values they already hold
+    obs_server = None
+    health = None
+    sched_metrics = None
+    if args.metrics_port:
+        from poseidon_tpu.obs import (
+            HealthState,
+            MetricsRegistry,
+            ObsServer,
+            SchedulerMetrics,
+        )
+
+        sched_metrics = SchedulerMetrics(MetricsRegistry())
+        # the latch owns the poseidon_ready gauge: both flip under one
+        # lock, so /readyz and /metrics can never disagree mid-scrape
+        health = HealthState(ready_gauge=sched_metrics.ready)
+        obs_server = ObsServer(
+            sched_metrics.registry, health, port=args.metrics_port,
+            host=args.metrics_host,
+        )
     bridge = SchedulerBridge(
         cost_model=args.flow_scheduling_cost_model,
         max_tasks_per_machine=args.max_tasks_per_pu,
@@ -359,6 +401,8 @@ def run_loop(args: argparse.Namespace) -> int:
         topk_prefs=args.topk_prefs,
         express_lane=args.express_lane == "true",
         express_max_batch=args.express_max_batch,
+        metrics=sched_metrics,
+        profile_spans=args.trace_profile == "true",
     )
     incremental = args.run_incremental_scheduler == "true"
     pipelined = args.round_pipeline == "true"
@@ -371,6 +415,7 @@ def run_loop(args: argparse.Namespace) -> int:
             client,
             trace=bridge.trace,
             max_lag_s=args.watch_max_lag,
+            metrics=sched_metrics,
         )
     express = args.express_lane == "true"
     if express and watcher is None:
@@ -395,6 +440,19 @@ def run_loop(args: argparse.Namespace) -> int:
             "(--run_incremental_scheduler=true); every express batch "
             "will degrade to the round path"
         )
+    # the lane label every round's stats carry (the metrics/report
+    # grouping key): the driver is the one place that knows which
+    # observe/dispatch composition is actually running
+    lane = "express" if express else (
+        "watch" if watcher is not None else "poll"
+    )
+    if pipelined:
+        lane += "+pipelined"
+    if args.mesh_width:
+        lane += "+sharded"
+    if args.aggregate_classes == "true":
+        lane += "+agg"
+    bridge.lane = lane
 
     def _observe_tick() -> bool:
         """One tick's cluster observation; False = skip the tick."""
@@ -479,7 +537,8 @@ def run_loop(args: argparse.Namespace) -> int:
                 # transitions whether or not a placement happens.
                 _post_express(
                     bridge.express_batch(
-                        ev.pod_events, t_event=ev.t_first
+                        ev.pod_events, t_event=ev.t_first,
+                        t_events=ev.t_events,
                     )
                 )
             if ev.needs_tick:
@@ -536,6 +595,11 @@ def run_loop(args: argparse.Namespace) -> int:
         (any not-yet-POSTed deltas are flushed before exiting)."""
         nonlocal rounds
         _log_round(result)
+        if health is not None:
+            # /readyz flips once a round over real observed state
+            # landed — proven-empty counts (the latch updates the
+            # poseidon_ready gauge itself)
+            health.mark_round(result.stats.backend)
         rounds += 1
         if args.max_rounds and rounds >= args.max_rounds:
             if flush:
@@ -543,12 +607,20 @@ def run_loop(args: argparse.Namespace) -> int:
             return True
         return False
 
+    # bind only once construction can no longer raise: an exception
+    # above would skip the finally below and leak the bound port +
+    # serving thread into the caller's process (tests, CI smoke)
+    if obs_server is not None:
+        obs_server.start()
     try:
         while True:
             tick_start = time.perf_counter()
             if not _observe_tick():
                 time.sleep(args.polling_frequency / 1e6)
                 continue
+            if health is not None:
+                # the seed LIST / first successful snapshot is applied
+                health.mark_seeded()
             if not incremental and not pipelined:
                 bridge.warm_state = None
             try:
@@ -648,6 +720,8 @@ def run_loop(args: argparse.Namespace) -> int:
     finally:
         if watcher is not None:
             watcher.stop()
+        if obs_server is not None:
+            obs_server.stop()
         if stats_fh:
             stats_fh.close()
         if trace_fh:

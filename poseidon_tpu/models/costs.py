@@ -118,6 +118,8 @@ def build_cost_inputs_host(
     machine_load: np.ndarray | None = None,
     machine_mem_free: np.ndarray | None = None,
     machine_used_slots: np.ndarray | None = None,
+    t_min: int = 1,
+    m_min: int = 1,
 ) -> CostInputs:
     """Assemble padded pricing inputs from builder metadata + KB aggregates,
     as HOST numpy arrays (no device traffic).
@@ -126,11 +128,20 @@ def build_cost_inputs_host(
     ``KnowledgeBase`` aggregates; they default to an idle, unsampled
     cluster. Shapes: per-task arrays length n_tasks, per-machine length
     n_machines (padded here).
+
+    ``t_min``/``m_min`` are grow-only padding-bucket floors from the
+    owning solver (the same anti-recompile hysteresis ``pad_topology``
+    applies): without them, a pending pool draining across a bucket
+    boundary shrinks the per-task input shapes and recompiles the
+    whole fused chain on a round whose topology padding stayed put —
+    bench config 10 (``observability_overhead``) caught exactly that
+    as a multi-second dispatch on every post-drain round.
     """
     E = arc_slots
     T = len(meta.task_uids)
     M = len(meta.machine_names)
-    Tp, Mp = pad_bucket(max(T, 1)), pad_bucket(max(M, 1))
+    Tp = pad_bucket(max(T, 1), minimum=t_min)
+    Mp = pad_bucket(max(M, 1), minimum=m_min)
 
     def pad_arc(a: np.ndarray, fill: int) -> np.ndarray:
         out = np.full(E, fill, np.int32)

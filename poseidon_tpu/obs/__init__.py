@@ -1,0 +1,42 @@
+"""Observability: metrics, health endpoints, span profiling, reports.
+
+The reference collects ``SchedulerStats`` per round and drops them
+(scheduler_bridge.cc:170-172); seven PRs in, this reproduction has five
+latency-critical lanes and a dozen loud degradation paths, and tailing
+a JSONL file is not an operational surface. This package is that
+surface, in three pieces:
+
+- ``metrics``: a dependency-free metrics registry (counters, gauges,
+  fixed-bucket histograms) rendered in Prometheus text exposition
+  format, plus ``SchedulerMetrics`` — the one place every instrument
+  the scheduler feeds is declared. Recording happens exclusively from
+  values the hot path already holds on the host (stats fields,
+  perf-counter durations, outcome counters) at finish/actuate time:
+  no new device syncs, PTA001-clean by construction and registered
+  with the contract linter from day one.
+- ``server``: a daemon-thread HTTP server exposing ``/metrics``,
+  ``/healthz`` (process liveness) and ``/readyz`` (ready = the seed
+  LIST applied AND the first certified round completed), with
+  ``HealthState`` as the driver-fed readiness latch.
+- ``spans`` + ``report``: phase-span profiling (every round and
+  express batch emits a structured span tree into the trace stream as
+  a ``SPAN`` event; an exporter turns them into Chrome-trace/Perfetto
+  JSON), and the trace analysis backend behind
+  ``python -m poseidon_tpu.trace report`` — round-latency percentiles
+  by lane/build-mode, express event-to-bind percentiles,
+  degrade/resync/timeout tallies with reasons, placement-churn
+  summaries.
+"""
+
+from poseidon_tpu.obs.metrics import (
+    MetricsRegistry,
+    SchedulerMetrics,
+)
+from poseidon_tpu.obs.server import HealthState, ObsServer
+
+__all__ = [
+    "HealthState",
+    "MetricsRegistry",
+    "ObsServer",
+    "SchedulerMetrics",
+]

@@ -1,0 +1,504 @@
+"""Dependency-free metrics registry + the scheduler's instrument set.
+
+Three instrument kinds, all label-aware, all guarded by ONE registry
+lock (recording sites run inside the round's hot path on the driver
+thread; ``render`` runs on the metrics server's handler thread — the
+shared lock is the documented discipline, declared in
+``analysis/contracts.py`` under PTA004):
+
+- ``Counter``: monotonically increasing float (``inc``);
+- ``Gauge``: last-write-wins float (``set``);
+- ``Histogram``: fixed cumulative buckets + sum + count (``observe``).
+  Buckets are FIXED at registration — no dynamic re-bucketing on the
+  hot path, one tuple shared by every labelset.
+
+``render()`` emits Prometheus text exposition format (version 0.0.4):
+``# HELP`` / ``# TYPE`` headers, ``name{label="v"} value`` samples,
+``_bucket``/``_sum``/``_count`` for histograms with cumulative ``le``.
+
+``SchedulerMetrics`` declares every instrument the scheduler feeds and
+owns the recording helpers the bridge / resident solver / watcher /
+express lane call. The contract: recording happens at finish/actuate
+time ONLY, from host-side values the caller already holds (stats
+fields, perf-counter durations, outcome counts) — never a device
+fetch, never an O(cluster) walk. The helpers are registered as
+PTA001/PTA002 scopes so the linter enforces that, and
+``tests/test_obs.py`` + bench config 10 (``observability_overhead``)
+prove the surface costs <2% of a flagship churned-warm round.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+# Default latency buckets (milliseconds): spans sub-ms express repairs
+# through multi-second degraded rounds. One shared tuple — the bucket
+# loop on the hot path is a fixed 13 iterations, not data-dependent.
+LATENCY_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0,
+)
+
+# Express event-to-bind buckets: the lane's budget is single-digit ms,
+# so the resolution lives there.
+E2B_BUCKETS_MS = (
+    0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 8.0, 13.0, 25.0, 50.0, 100.0, 250.0,
+)
+
+
+def _labelkey(labels: dict) -> tuple:
+    """Canonical hashable key for one labelset."""
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus sample value: integers render without the '.0'."""
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _fmt_labels(key: tuple, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Instrument:
+    """Base: name, help text, and the registry's shared lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, lock: threading.Lock):
+        self.name = name
+        self.help = help_
+        self._lock = lock
+
+    def _render(self, out: list[str]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str, lock: threading.Lock):
+        super().__init__(name, help_, lock)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount == 0:
+            return
+        key = _labelkey(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def _render(self, out: list[str]) -> None:
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {_fmt_value(v)}")
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str, lock: threading.Lock):
+        super().__init__(name, help_, lock)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_labelkey(labels)] = float(value)
+
+    def _render(self, out: list[str]) -> None:
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {_fmt_value(v)}")
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(
+        self, name: str, help_: str, lock: threading.Lock,
+        buckets: tuple[float, ...],
+    ):
+        super().__init__(name, help_, lock)
+        self.buckets = tuple(sorted(buckets))
+        # labelset -> (per-bucket counts list, sum, count)
+        self._values: dict[tuple, list] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = _labelkey(labels)
+        with self._lock:
+            slot = self._values.get(key)
+            if slot is None:
+                slot = [[0] * len(self.buckets), 0.0, 0]
+                self._values[key] = slot
+            counts, _, _ = slot
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    counts[i] += 1
+            slot[1] += value
+            slot[2] += 1
+
+    def _render(self, out: list[str]) -> None:
+        for key, (counts, total, n) in sorted(self._values.items()):
+            for le, c in zip(self.buckets, counts):
+                le_label = 'le="%s"' % _fmt_value(le)
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(key, le_label)} {c}"
+                )
+            inf_label = 'le="+Inf"'
+            out.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(key, inf_label)} {n}"
+            )
+            out.append(
+                f"{self.name}_sum{_fmt_labels(key)} {_fmt_value(total)}"
+            )
+            out.append(f"{self.name}_count{_fmt_labels(key)} {n}")
+
+
+class MetricsRegistry:
+    """Owns every instrument + the one lock they all record under.
+
+    Registration is idempotent (same name returns the existing
+    instrument; a kind mismatch raises — two subsystems silently
+    sharing a name as different kinds is a bug).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Instrument] = {}
+
+    def _register(self, cls, name: str, help_: str, **kw) -> _Instrument:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}, not {cls.kind}"
+                    )
+                return existing
+            m = cls(name, help_, self._lock, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._register(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._register(Gauge, name, help_)
+
+    def histogram(
+        self, name: str, help_: str = "",
+        buckets: tuple[float, ...] = LATENCY_BUCKETS_MS,
+    ) -> Histogram:
+        return self._register(Histogram, name, help_, buckets=buckets)
+
+    def render(self) -> str:  # pta: background-thread
+        """Prometheus text exposition; called from the metrics server's
+        handler thread (the shared lock is the cross-thread contract)."""
+        out: list[str] = []
+        with self._lock:
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                if m.help:
+                    out.append(f"# HELP {name} {m.help}")
+                out.append(f"# TYPE {name} {m.kind}")
+                m._render(out)
+        return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# the scheduler's instrument set
+# ---------------------------------------------------------------------------
+
+# resync-storm detection: gauge flips to 1 when the last STORM_WINDOW
+# rounds saw >= STORM_RESYNCS full-LIST resyncs (a flapping watch
+# stream re-listing the cluster every tick is an operational incident,
+# not a per-event log line)
+STORM_WINDOW = 8
+STORM_RESYNCS = 3
+
+# deliberate oracle ROUTING (small instances, non-taxonomy graphs) is
+# dispatch, not degradation — mirrors the bridge's degrades_total rule
+_ROUTED_WHYS = ("small-instance", "not-scheduling-shaped")
+
+
+def _backend_family(backend: str) -> str:
+    if not backend:
+        return "empty"
+    if backend.startswith("oracle:"):
+        return "oracle"
+    return "dense"
+
+
+def resync_reason_label(reason: str) -> str:
+    """Bounded label for a free-text resync reason (Prometheus label
+    cardinality must stay finite)."""
+    if "410" in reason:
+        return "gone"
+    if "watch_max_lag" in reason:
+        return "stale"
+    if "unparseable" in reason or "undecodable" in reason:
+        return "decode"
+    return "error"
+
+
+class SchedulerMetrics:
+    """Every instrument the scheduler feeds, plus recording helpers.
+
+    One instance per daemon, shared by the bridge, the resident solver,
+    and the watcher. All ``record_*`` methods take host-side values the
+    caller already holds — they are registered PTA001/PTA002 hot
+    scopes, so the linter rejects any device sync or cluster-sized walk
+    slipping in later.
+    """
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.rounds = registry.counter(
+            "poseidon_rounds_total",
+            "scheduling rounds completed, by lane and backend family",
+        )
+        self.round_latency = registry.histogram(
+            "poseidon_round_latency_ms",
+            "per-round host critical path (SchedulerStats.total_ms), "
+            "by lane and build mode",
+        )
+        self.round_phase = registry.gauge(
+            "poseidon_round_phase_ms",
+            "last round's per-phase host timers, by phase",
+        )
+        self.pods = registry.gauge(
+            "poseidon_pods",
+            "pod counts at the last round, by state",
+        )
+        self.round_cost = registry.gauge(
+            "poseidon_round_cost",
+            "last round's exact solve objective",
+        )
+        self.deltas = registry.counter(
+            "poseidon_deltas_total",
+            "scheduling deltas emitted, by kind "
+            "(place/migrate/preempt/noop/deferred)",
+        )
+        self.evictions = registry.counter(
+            "poseidon_evictions_total",
+            "tasks evicted by node loss",
+        )
+        self.bind_failures = registry.counter(
+            "poseidon_bind_failures_total",
+            "binding/actuation POSTs that failed (pod re-queued)",
+        )
+        self.fetch_timeouts = registry.counter(
+            "poseidon_fetch_timeouts_total",
+            "pipelined placement fetches that missed "
+            "--max_solver_runtime",
+        )
+        self.degrades = registry.counter(
+            "poseidon_degrades_total",
+            "dense-lane degrades to the CPU oracle, by why "
+            "(deliberate small-instance routing is not counted)",
+        )
+        self.degraded = registry.gauge(
+            "poseidon_degraded",
+            "1 while the most recent SOLVING round degraded to the "
+            "oracle, by why; cleared by the next non-degraded solve "
+            "(certified dense or deliberate oracle routing); empty "
+            "no-solve rounds leave it unchanged",
+        )
+        self.watch_resyncs = registry.counter(
+            "poseidon_watch_resyncs_total",
+            "watch degradations to a full LIST resync, by reason "
+            "(gone/decode/stale/error)",
+        )
+        self.watch_reconnects = registry.counter(
+            "poseidon_watch_reconnects_total",
+            "error-path watch stream reconnects, by resource",
+        )
+        self.resync_storm = registry.gauge(
+            "poseidon_watch_resync_storm",
+            f"1 while >= {STORM_RESYNCS} resyncs landed within the "
+            f"last {STORM_WINDOW} rounds",
+        )
+        self.express_batches = registry.counter(
+            "poseidon_express_batches_total",
+            "express-lane batches that completed certified "
+            "(including retire/completion-only batches with zero "
+            "placements)",
+        )
+        self.express_places = registry.counter(
+            "poseidon_express_places_total",
+            "pods bound between round ticks by the express lane",
+        )
+        self.express_degrades = registry.counter(
+            "poseidon_express_degrades_total",
+            "express batches that fell back to the round path, by why",
+        )
+        self.express_corrected = registry.counter(
+            "poseidon_express_corrected_total",
+            "express placements the correction round moved",
+        )
+        self.express_e2b = registry.histogram(
+            "poseidon_express_e2b_ms",
+            "express event-to-bind-decision latency",
+            buckets=E2B_BUCKETS_MS,
+        )
+        self.solver_fetches = registry.counter(
+            "poseidon_solver_fetches_total",
+            "sanctioned device->host placement fetches, by lane "
+            "(round/express)",
+        )
+        self.solver_warm = registry.gauge(
+            "poseidon_solver_warm",
+            "1 while a warm on-HBM DenseState is live",
+        )
+        self.express_context_ready = registry.gauge(
+            "poseidon_express_context_ready",
+            "1 while a warm express context is patchable between ticks",
+        )
+        self.ready = registry.gauge(
+            "poseidon_ready",
+            "the /readyz latch: 1 after seed LIST + first round over "
+            "real state (certified solve or proven-empty)",
+        )
+        # degraded-gauge bookkeeping: whys currently set to 1, so a
+        # recovery round can clear exactly what an earlier round set
+        self._degraded_whys: set[str] = set()
+        self._resync_window: collections.deque[int] = collections.deque(
+            maxlen=STORM_WINDOW
+        )
+
+    # ---- per-round recording (bridge finish/begin path) ---------------
+
+    def record_round(self, stats) -> None:
+        """Record one completed round from its ``SchedulerStats`` —
+        every input is a host float/int the bridge already computed."""
+        lane = stats.lane or "round"
+        family = _backend_family(stats.backend)
+        self.rounds.inc(lane=lane, backend=family)
+        if stats.backend:
+            # latency/cost/phase describe a SOLVE: an idle cluster's
+            # empty rounds (one per tick, ~µs total_ms, cost 0) would
+            # otherwise collapse the histogram's p50 toward zero and
+            # clobber the last real round's gauges — the same rounds
+            # the trace report excludes ("no solve to time")
+            self.round_latency.observe(
+                stats.total_ms, lane=lane,
+                build_mode=stats.build_mode or "none",
+            )
+            for phase, dur in (
+                ("observe", stats.observe_ms),
+                ("build", stats.build_ms),
+                ("price", stats.price_ms),
+                ("solve", stats.solve_ms),
+                ("decompose", stats.decompose_ms),
+                ("dispatch", stats.dispatch_ms),
+                ("fetch_wait", stats.fetch_wait_ms),
+                ("overlap", stats.overlap_ms),
+            ):
+                self.round_phase.set(dur, phase=phase)
+            self.round_cost.set(stats.cost)
+        self.pods.set(stats.pods_total, state="total")
+        self.pods.set(stats.pods_pending, state="pending")
+        self.pods.set(stats.pods_placed, state="placed")
+        self.pods.set(stats.pods_unscheduled, state="unscheduled")
+        self.deltas.inc(stats.deltas_place, kind="place")
+        self.deltas.inc(stats.deltas_migrate, kind="migrate")
+        self.deltas.inc(stats.deltas_preempt, kind="preempt")
+        self.deltas.inc(stats.deltas_noop, kind="noop")
+        self.deltas.inc(stats.deltas_deferred, kind="deferred")
+        self.evictions.inc(stats.evictions)
+        self.bind_failures.inc(stats.bind_failures)
+        self.fetch_timeouts.inc(stats.fetch_timeouts)
+        self.express_corrected.inc(stats.express_corrected)
+        # degraded-to-oracle state as a labeled gauge tracking the
+        # most recent SOLVE: set on a degraded round, cleared by any
+        # non-degraded solve (certified dense or deliberately-routed
+        # oracle). Empty rounds carry no solve evidence either way.
+        why = ""
+        if stats.backend.startswith("oracle:"):
+            w = stats.backend.split(":", 1)[1]
+            if w not in _ROUTED_WHYS:
+                why = w
+        if why:
+            self.degraded.set(1, why=why)
+            self._degraded_whys.add(why)
+        elif stats.backend:
+            for w in self._degraded_whys:
+                self.degraded.set(0, why=w)
+            self._degraded_whys.clear()
+        # resync storm over a sliding round window
+        self._resync_window.append(stats.watch_resyncs)
+        self.resync_storm.set(
+            1 if sum(self._resync_window) >= STORM_RESYNCS else 0
+        )
+
+    def record_degrade(self, why: str) -> None:
+        """One non-deliberate dense-lane degrade (the DEGRADE event's
+        metrics twin)."""
+        self.degrades.inc(why=why)
+
+    # ---- express lane --------------------------------------------------
+
+    def record_express_batch(self, e2b_ms_samples) -> None:
+        """One certified express dispatch: per-placement
+        event-to-bind-decision samples (already computed from
+        perf-counter stamps; empty for a retire/completion-only
+        batch)."""
+        self.express_batches.inc()
+        self.express_places.inc(len(e2b_ms_samples))
+        for e2b in e2b_ms_samples:
+            self.express_e2b.observe(e2b)
+
+    def record_express_degrade(self, why: str) -> None:
+        self.express_degrades.inc(why=_bounded_why(why))
+
+    # ---- watch subsystem ----------------------------------------------
+
+    def record_resync(self, reason: str) -> None:
+        self.watch_resyncs.inc(reason=resync_reason_label(reason))
+
+    def record_reconnect(self, resource: str) -> None:
+        self.watch_reconnects.inc(resource=resource)
+
+    # ---- resident solver ----------------------------------------------
+
+    def record_solver_round(
+        self, fetches: int, warm: bool, express_ready: bool
+    ) -> None:
+        """Called by the solver at finish time: sanctioned-fetch count
+        and warm-state liveness (host ints/bools it already holds)."""
+        self.solver_fetches.inc(fetches, lane="round")
+        self.solver_warm.set(1 if warm else 0)
+        self.express_context_ready.set(1 if express_ready else 0)
+
+    def record_express_fetch(self) -> None:
+        self.solver_fetches.inc(lane="express")
+
+
+# express degrade reasons are free text (they embed uids/counts);
+# collapse to a bounded vocabulary for the label
+_WHY_BUCKETS = (
+    ("unconfirmed", "unconfirmed"),
+    ("domain", "domain"),
+    ("uncertified", "uncertified"),
+    ("change cap", "change-cap"),
+    ("arrivals >", "batch-size"),
+    ("rows exhausted", "rows-exhausted"),
+    ("no-context", "no-context"),
+    ("no warm state", "no-context"),
+    ("round-in-flight", "round-in-flight"),
+    ("class", "aggregation"),
+    ("prefs", "prefs"),
+)
+
+
+def _bounded_why(why: str) -> str:
+    for needle, label in _WHY_BUCKETS:
+        if needle in why:
+            return label
+    return "vocabulary"

@@ -127,16 +127,23 @@ class DenseTopology:
 
 
 def pad_topology(
-    topo: TransportTopology, *, t_min: int = 16, m_min: int = 16
+    topo: TransportTopology, *, t_min: int = 16, m_min: int = 16,
+    p_min: int = 0,
 ) -> DenseTopology:
     """Host-side padding of the skeleton (numpy; upload happens batched).
 
     ``t_min``/``m_min`` are grow-only bucket floors from the owning
     solver: with the fine (multiple-of-1024) bucket ladder, a task
     count oscillating across a bucket boundary would otherwise
-    recompile the whole device chain every other round.
+    recompile the whole device chain every other round. ``p_min``
+    floors the preference-column axis the same way (extra columns are
+    all-absent, fill -1): the max pref count over PENDING tasks is
+    data-dependent, and a multi-pref pod draining out of the pending
+    pool would otherwise shrink the static ``n_prefs`` and recompile
+    the chain mid-steady-state (bench config 10 caught this one too).
     """
-    T, M, P = topo.n_tasks, topo.n_machines, topo.max_prefs
+    T, M = topo.n_tasks, topo.n_machines
+    P = max(topo.max_prefs, p_min)
     Tp = pad_bucket(max(T, 1), minimum=t_min)
     Mp = pad_bucket(max(M, 1), minimum=m_min)
 
@@ -802,8 +809,14 @@ class ResidentSolver:
         express_lane: bool = False,
         express_max_batch: int = 16,
         express_change_cap: int = 256,
+        metrics=None,
     ):
         self.alpha = alpha
+        # observability (obs.SchedulerMetrics or None): the solver
+        # reports its sanctioned-fetch counts and warm/express-context
+        # liveness at finish time — host ints/bools it already holds,
+        # never a device sync (PTA001)
+        self.metrics = metrics
         self.max_rounds = max_rounds
         self.oracle_fallback = oracle_fallback
         self.oracle_timeout_s = oracle_timeout_s
@@ -837,6 +850,15 @@ class ResidentSolver:
         self._e_floor = 16
         self._t_floor = 16
         self._m_floor = 16
+        # cost-input floors: build_cost_inputs_host pads per-task /
+        # per-machine arrays by ITS OWN buckets of the raw counts, so
+        # a draining pending pool would shrink those shapes (and
+        # recompile the fused chain) even while pad_topology's floors
+        # hold — the floors travel together (bench config 10)
+        self._ti_floor = 1
+        self._mi_floor = 1
+        self._s_floor = 1
+        self._p_floor = 0
         # one round in flight at a time
         self._inflight = False
         # observability: lifetime fetch-deadline misses, and how many
@@ -947,8 +969,15 @@ class ResidentSolver:
             max(meta.n_arcs, 1), minimum=self._e_floor
         )
         E = self._e_floor
+        self._ti_floor = pad_bucket(
+            max(len(meta.task_uids), 1), minimum=self._ti_floor
+        )
+        self._mi_floor = pad_bucket(
+            max(len(meta.machine_names), 1), minimum=self._mi_floor
+        )
         inputs_host = build_cost_inputs_host(
-            E, meta, **(cost_input_kwargs or {})
+            E, meta, t_min=self._ti_floor, m_min=self._mi_floor,
+            **(cost_input_kwargs or {}),
         )
 
         def degrade(why: str, topo, *, price_on_cpu: bool = False):
@@ -1033,8 +1062,13 @@ class ResidentSolver:
                 machine_used_slots=kw.get("machine_used_slots"),
             )
             topo = aggregate_topology(base_topo, agg_plan)
+        # pref-axis floor: grow-only like t/m (the pref width is the
+        # static n_prefs — see pad_topology's p_min docstring)
+        self._p_floor = max(topo.max_prefs, self._p_floor)
+        P = self._p_floor
         dt_host = pad_topology(
-            topo, t_min=self._t_floor, m_min=self._m_floor
+            topo, t_min=self._t_floor, m_min=self._m_floor,
+            p_min=self._p_floor,
         )
         Tp = dt_host.arc_unsched.shape[0]
         Mp = dt_host.slots.shape[0]
@@ -1051,6 +1085,10 @@ class ResidentSolver:
             self._warm = None
             self._t_floor = 16
             self._m_floor = 16
+            self._ti_floor = 1
+            self._mi_floor = 1
+            self._s_floor = 1
+            self._p_floor = 0
             if not self.oracle_fallback:
                 raise
             log.warning(
@@ -1061,11 +1099,17 @@ class ResidentSolver:
         self._t_floor = Tp
         self._m_floor = Mp
         # power-of-two smax bound: top_k cost grows mildly with smax but
-        # the static argument stays stable as per-round free slots churn
-        smax = min(
-            pad_bucket(max(int(topo.slots.max(initial=1)), 1), minimum=1),
-            dt_host.arc_unsched.shape[0],
+        # the static argument stays stable as per-round free slots
+        # churn. Grow-only like the other floors: a packing cluster
+        # shrinks its max free seats across bucket boundaries, and
+        # since smax is a STATIC argument each shrink would recompile
+        # the fused chain (smax is a bound, not an exact count, so
+        # holding the floor changes nothing but the top_k window)
+        self._s_floor = pad_bucket(
+            max(int(topo.slots.max(initial=1)), 1),
+            minimum=self._s_floor,
         )
+        smax = min(self._s_floor, dt_host.arc_unsched.shape[0])
         timings["prep_ms"] = (time.perf_counter() - t0) * 1000
 
         # ---- upload + ONE fused program + ONE (async) sync -----------
@@ -1341,6 +1385,12 @@ class ResidentSolver:
                 Mp=Mp,
                 T=T,
                 scale=T + 1,
+            )
+        if self.metrics is not None:
+            self.metrics.record_solver_round(
+                self.last_round_fetches,
+                self._warm is not None,
+                self._express is not None,
             )
         return ResidentOutcome(
             assignment=asg,
@@ -1698,6 +1748,8 @@ class ResidentSolver:
                         change_cap=self.express_change_cap,
                     )
             self.express_fetches += 1
+            if self.metrics is not None:
+                self.metrics.record_express_fetch()
             with sanctioned_transfer():
                 (rows_np, asg_np, n_chg, conv_np, dom_np, rnds_np,
                  primal_np) = jax.device_get((  # noqa: PTA001 -- the express batch's ONE sanctioned fetch: only the affected placements + certificate bits
@@ -1800,6 +1852,12 @@ class ResidentSolver:
         else:
             channel = np.full(T, -1, np.int32)
         timings["oracle_ms"] = (time.perf_counter() - t0) * 1000
+        if self.metrics is not None:
+            self.metrics.record_solver_round(
+                self.last_round_fetches,
+                self._warm is not None,
+                self._express is not None,
+            )
         return ResidentOutcome(
             assignment=asg,
             channel=channel,

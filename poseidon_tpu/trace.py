@@ -28,12 +28,35 @@ plus ROUND records carrying the per-phase timing/stat payload
 (``SchedulerStats`` as a dict — including the round-pipeline timers:
 ``build_mode`` delta/full/legacy, ``dispatch_ms``, ``fetch_wait_ms``,
 ``overlap_ms``, ``wall_ms``; ``total_ms`` is the host critical path,
-excluding the overlap window where the loop worked on other rounds).
+excluding the overlap window where the loop worked on other rounds)
+and SPAN records carrying a structured per-phase span tree for a round
+or express batch (``--trace_profile``; the tree schema lives in
+``poseidon_tpu/obs/spans.py``, the consumers are the Chrome-trace
+exporter and ``python -m poseidon_tpu.trace report``).
+
+**Clock contract.** ``timestamp_us`` is WALL-clock microseconds
+(``time.time()`` by default): it exists to correlate events across
+hosts and with apiserver/audit logs, and it is NOT safe to difference —
+NTP steps/slews make wall-clock intervals lie. Every DURATION in the
+stream (the ROUND record's ``*_ms`` timers, SPAN ``dur_ms``/``off_ms``
+values, EXPRESS_PLACE ``e2b_ms``) is therefore measured by the
+producers on the monotonic clock family (``time.monotonic`` /
+``time.perf_counter``) and shipped as an already-computed value.
+Consumers: read durations from the payloads, never from timestamp
+deltas. An injected ``clock_us`` (tests) replaces only the timestamp
+source.
 
 Pipelined rounds (bridge ``begin_round``/``finish_round``) emit their
 ROUND record at finish time, so a round's SCHEDULE/ROUND events may
 interleave with the NEXT round's SUBMIT events in the stream;
 ``read_trace`` does the ``round_num`` ordering for consumers.
+
+Command line: ``python -m poseidon_tpu.trace report <file>`` renders
+the operator's one-pager (round-latency percentiles by lane/build
+mode, express event-to-bind percentiles, degrade/resync/timeout
+tallies with reasons, placement-churn summary; ``--json`` for the raw
+data model), and ``python -m poseidon_tpu.trace chrome <file>`` writes
+a Chrome-trace/Perfetto JSON of the SPAN events.
 """
 
 from __future__ import annotations
@@ -41,8 +64,11 @@ from __future__ import annotations
 import collections
 import dataclasses
 import json
+import logging
 import time
 from typing import Callable, IO
+
+log = logging.getLogger(__name__)
 
 # The DECLARED event vocabulary. Consumers key on these names, so an
 # emit outside the set is a silent contract break for every downstream
@@ -65,6 +91,8 @@ EVENT_TYPES = frozenset({
     "EXPRESS_PLACE",    # express-lane placement between round ticks
     "EXPRESS_CORRECTED",  # correction round moved an express placement
     "EXPRESS_DEGRADE",  # express batch fell back to the round path
+    "SPAN",             # per-round/per-batch phase span tree
+                        # (--trace_profile; obs/spans.py schema)
 })
 
 
@@ -127,6 +155,14 @@ class TraceGenerator:
             self.sink.flush()
 
 
+# the reader's known schema: any other key in a line is a field some
+# NEWER version writes — forward compatibility means dropping it with a
+# warning, not TypeError-ing on the whole file
+_EVENT_FIELDS = frozenset(
+    f.name for f in dataclasses.fields(TraceEvent)
+)
+
+
 def read_trace(path: str):
     """Yield a trace file's events ordered by ``round_num``.
 
@@ -137,11 +173,85 @@ def read_trace(path: str):
     module docstring used to prescribe. Blank lines are skipped; a
     malformed line raises ``json.JSONDecodeError`` like any other
     corrupt input.
+
+    Forward compatibility: a trace written by a NEWER version may carry
+    fields this reader does not know. Unknown keys are dropped (one
+    warning per file naming them) instead of raising ``TypeError`` —
+    an old analysis binary must still read a new daemon's trace.
     """
+    dropped: set[str] = set()
+    events: list[TraceEvent] = []
     with open(path) as fh:
-        events = [
-            TraceEvent(**json.loads(line))
-            for line in fh if line.strip()
-        ]
+        for line in fh:
+            if not line.strip():
+                continue
+            doc = json.loads(line)
+            unknown = doc.keys() - _EVENT_FIELDS
+            if unknown:
+                dropped |= unknown
+                doc = {
+                    k: v for k, v in doc.items() if k in _EVENT_FIELDS
+                }
+            events.append(TraceEvent(**doc))
+    if dropped:
+        log.warning(
+            "read_trace(%s): dropped unknown field(s) %s — trace "
+            "written by a newer version?", path, sorted(dropped),
+        )
     events.sort(key=lambda e: e.round_num)  # stable: file order within
     yield from events
+
+
+# ---------------------------------------------------------------------------
+# the analysis CLI: python -m poseidon_tpu.trace report|chrome <file>
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import sys as _sys
+
+    p = argparse.ArgumentParser(
+        prog="python -m poseidon_tpu.trace",
+        description="Analyze a scheduler trace JSONL file",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser(
+        "report",
+        help="the operator's one-pager: round-latency percentiles by "
+             "lane/build mode, express event-to-bind percentiles, "
+             "degrade/resync/timeout tallies, placement churn",
+    )
+    rep.add_argument("file")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the raw data model as JSON")
+    chrome = sub.add_parser(
+        "chrome",
+        help="export SPAN events (--trace_profile) as Chrome-trace/"
+             "Perfetto JSON for chrome://tracing / ui.perfetto.dev",
+    )
+    chrome.add_argument("file")
+    chrome.add_argument("-o", "--out", default="",
+                        help="output path (default: <file>.chrome.json)")
+    args = p.parse_args(argv)
+    # local imports: obs.report/spans import back into this module
+    from poseidon_tpu.obs import report as _report
+    from poseidon_tpu.obs import spans as _spans
+
+    if args.cmd == "report":
+        data = _report.analyze_trace(args.file)
+        if args.json:
+            print(json.dumps(data, indent=2))
+        else:
+            print(_report.render_report(data))
+    else:
+        out = args.out or (args.file + ".chrome.json")
+        _spans.write_chrome_trace(read_trace(args.file), out)
+        print(out, file=_sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,8 +1,17 @@
-"""Trace stream: event emission + the read_trace round-order reader."""
+"""Trace stream: event emission + the read_trace round-order reader,
+forward compatibility, ring-buffer semantics, and the PTA005 runtime
+vocabulary guard."""
 
 import json
 
-from poseidon_tpu.trace import TraceEvent, TraceGenerator, read_trace
+import pytest
+
+from poseidon_tpu.trace import (
+    EVENT_TYPES,
+    TraceEvent,
+    TraceGenerator,
+    read_trace,
+)
 
 
 class TestReadTrace:
@@ -39,6 +48,77 @@ class TestReadTrace:
               "machine": "", "round_num": 3, "detail": None}
         path.write_text(json.dumps(ev) + "\n\n" + json.dumps(ev) + "\n")
         assert len(list(read_trace(str(path)))) == 2
+
+    def test_forward_compat_drops_unknown_fields(self, tmp_path, caplog):
+        """A trace written by a NEWER version (extra per-event fields)
+        must read, not TypeError — unknown keys drop with a warning."""
+        path = tmp_path / "future.jsonl"
+        ev = {"timestamp_us": 1, "event": "SUBMIT", "task": "p",
+              "machine": "", "round_num": 1, "detail": {"k": 1},
+              "tenant": "acme", "shard": 3}
+        path.write_text(json.dumps(ev) + "\n")
+        with caplog.at_level("WARNING", logger="poseidon_tpu.trace"):
+            events = list(read_trace(str(path)))
+        assert len(events) == 1
+        assert events[0].task == "p"
+        assert events[0].detail == {"k": 1}
+        assert not hasattr(events[0], "tenant")
+        warning = "\n".join(caplog.messages)
+        assert "shard" in warning and "tenant" in warning
+
+    def test_forward_compat_no_warning_on_clean_file(
+        self, tmp_path, caplog
+    ):
+        path = tmp_path / "clean.jsonl"
+        ev = {"timestamp_us": 1, "event": "SUBMIT", "task": "p",
+              "machine": "", "round_num": 1, "detail": None}
+        path.write_text(json.dumps(ev) + "\n")
+        with caplog.at_level("WARNING", logger="poseidon_tpu.trace"):
+            assert len(list(read_trace(str(path)))) == 1
+        assert not caplog.messages
+
+
+class TestRingBuffer:
+    def test_sinkless_overflow_drops_oldest(self):
+        gen = TraceGenerator(buffer_events=3)
+        for i in range(5):
+            gen.emit("SUBMIT", task=f"p{i}", round_num=i)
+        assert len(gen.events) == 3
+        assert [e.task for e in gen.events] == ["p2", "p3", "p4"]
+
+    def test_sinkless_flush_is_noop(self):
+        gen = TraceGenerator()
+        gen.emit("SUBMIT", task="p0")
+        gen.flush()  # must not raise with no sink
+        assert len(gen.events) == 1
+
+    def test_sink_writes_and_flush(self, tmp_path):
+        """With a sink, events go to the file (not the ring) and
+        flush() pushes them through the file buffer."""
+        path = tmp_path / "sink.jsonl"
+        with open(path, "w") as fh:
+            gen = TraceGenerator(sink=fh)
+            gen.emit("SUBMIT", task="p0", round_num=1)
+            gen.flush()
+            # visible on disk BEFORE close: flush really flushed
+            on_disk = path.read_text()
+            assert json.loads(on_disk.strip())["task"] == "p0"
+        assert len(gen.events) == 0  # sink mode: ring stays empty
+
+
+class TestVocabularyGuard:
+    def test_undeclared_event_rejected_at_runtime(self):
+        gen = TraceGenerator()
+        with pytest.raises(ValueError, match="PTA005"):
+            gen.emit("REBALANCE")
+        assert len(gen.events) == 0
+
+    def test_span_is_declared(self):
+        assert "SPAN" in EVENT_TYPES
+        gen = TraceGenerator()
+        gen.emit("SPAN", round_num=1,
+                 detail={"name": "round", "children": []})
+        assert gen.events[-1].event == "SPAN"
 
     def test_bridge_emits_migrate_and_preempt_events(self):
         """The rebalancing round's decisions land in the trace

@@ -1737,11 +1737,299 @@ def bench_observability_overhead(
     return row
 
 
+def bench_service(n_tenants: int = 8, *, sync_floor_ms: float = 0.0) -> dict:
+    """Config 11 (service_multi_tenant): N heterogeneous tenant
+    clusters scheduled by ONE device through the service lane
+    (poseidon_tpu/service/) vs the reference's architecture of one
+    scheduler process per cluster.
+
+    The measured block runs >= 3 pipelined dispatch waves after a
+    2-wave warmup, with per-tenant churn (pending pods retired/arriving
+    each wave) — every wave re-decides each tenant's pending set from
+    its warm per-tenant context, the same steady-state re-solve economy
+    the flagship warm-churn headline measures. Reported:
+
+    - aggregate placements/sec across all tenants (the service's
+      throughput number) and per-tenant submit-to-result p99;
+    - the serial one-tenant-at-a-time counterfactual, DIRECTLY
+      measured: the same tenants scheduled through the same machinery
+      one tenant per wave — N schedulers each paying its own dispatch
+      and its own sanctioned fetch, the reference's one-scheduler-per-
+      cluster architecture on this device. On a linked accelerator
+      (the production regime: ``bench_tunnel`` measures ~100 ms flat
+      per sync on this environment) the serial lane pays N sync floors
+      where the batched wave pays ONE, and the >= 3x aggregate-
+      throughput assert is enforced. On a zero-sync-floor host (CPU
+      CI, directly-attached devices) the two lanes are the same
+      compute by construction — the ratio is reported, batching is
+      asserted not to LOSE throughput, and the hard 3x gate would be
+      vacuous either way (the gate keys on the measured floor, same
+      rule as config 4/9's sync decomposition). The reference-
+      architecture counterfactual (one C++ cs2-class solve per
+      cluster, serially) is timed and reported alongside;
+    - bit-identity: one tenant per shape bucket re-solved COLD inside
+      its bucket and compared bit-for-bit to its solo
+      ``solve_transport_dense`` (assignments equal, costs equal);
+    - zero steady-state recompiles across the measured waves
+      (CompileCounter, >= 3 dispatches after warmup), asserted.
+    """
+    import collections as _collections
+
+    from poseidon_tpu.cluster import Task
+    from poseidon_tpu.guards import CompileCounter
+    from poseidon_tpu.graph.network import FlowNetwork
+    from poseidon_tpu.ops.dense_auction import solve_transport_dense
+    from poseidon_tpu.oracle import solve_oracle
+    from poseidon_tpu.service import SchedulingService
+    from poseidon_tpu.synth import make_synthetic_cluster
+
+    # heterogeneous tenant fleet: distinct machine/task counts landing
+    # in ~3 shape buckets, cost models cycled across the registry.
+    # Utilization sits near 80% — real fleets keep headroom, and the
+    # near-100% packings are the documented tie-exhaustion corner of
+    # the auction (STATUS "Known limitations"), which is a kernel
+    # property, not a service one
+    # (models assigned per shape to ones the auction certifies there
+    # under churn — coco/wharemap both have bench-scale shapes whose
+    # knowledge-fed cost surface exhausts the round fuse, the
+    # pre-existing tie corner STATUS documents; those tenants would
+    # run exactly-but-on-the-oracle, which is the wrong lane to
+    # benchmark. The per-tenant exactness suite still covers
+    # coco/wharemap at certifying shapes — tests/test_service.py.)
+    shapes = [
+        (48, 380, "quincy"), (64, 520, "trivial"), (40, 300, "octopus"),
+        (96, 760, "quincy"), (48, 390, "trivial"), (80, 610, "octopus"),
+        (56, 430, "quincy"), (72, 560, "trivial"),
+    ]
+    while len(shapes) < n_tenants:
+        shapes.append(shapes[len(shapes) % 8])
+    shapes = shapes[:n_tenants]
+
+    service = SchedulingService()
+    clusters: dict[str, object] = {}
+    rng = np.random.default_rng(11)
+    for i, (m, t, model) in enumerate(shapes):
+        tid = f"tenant-{i}"
+        service.add_tenant(tid, cost_model=model)
+        clusters[tid] = make_synthetic_cluster(
+            m, t, seed=4000 + i, prefs_per_task=2
+        )
+        bridge = service.sessions[tid].bridge
+        bridge.observe_nodes(clusters[tid].machines)
+        bridge.observe_pods(clusters[tid].tasks)
+    tenants = list(clusters)
+
+    def churn(tid: str, wave: int) -> None:
+        """Retire a few pending pods, add a few arrivals (shapes
+        oscillate under the warmed grow-only floors)."""
+        c = clusters[tid]
+        pend = [t for t in c.tasks if t.machine == ""]
+        keep = pend[3:]
+        mach = c.machines
+        new = [
+            Task(
+                uid=f"{tid}-w{wave}-{k}",
+                job=f"{tid}-job-w{wave}",
+                cpu_request=0.25,
+                memory_request_kb=1 << 18,
+                data_prefs={
+                    mach[int(rng.integers(0, len(mach)))].name:
+                        int(rng.integers(20, 120))
+                },
+            )
+            for k in range(3)
+        ]
+        c.tasks[:] = keep + new
+        bridge = service.sessions[tid].bridge
+        bridge.observe_nodes(c.machines)
+        bridge.observe_pods(c.tasks)
+
+    lat = _collections.defaultdict(list)
+
+    def submit_all(wave: int):
+        futs = {}
+        for tid in tenants:
+            t0 = time.perf_counter()
+            fut = service.submit(tid)
+            fut.add_done_callback(
+                (lambda t, s: lambda _f: lat[t].append(
+                    (time.perf_counter() - s) * 1000
+                ))(tid, t0)
+            )
+            futs[tid] = fut
+        return futs
+
+    # ---- warmup: wave 1 compiles the cold member kernels, wave 2 the
+    # warm variants; everything after must compile NOTHING
+    log("bench: config 11 warmup (2 waves) ...")
+    for _ in range(2):
+        submit_all(-1)
+        service.pump()
+        service.flush()
+    lat.clear()
+    for s in service.sessions.values():
+        assert s.solver.last_backend == "dense_service", (
+            s.tenant_id, s.solver.last_backend
+        )
+
+    # ---- the measured block: pipelined waves with churn -------------
+    n_waves = 4
+    placements = 0
+    wave_results: list[dict] = []
+    dispatches_before = service.dispatcher.dispatches
+    counter = CompileCounter()
+    t_block = time.perf_counter()
+    with counter:
+        for w in range(n_waves):
+            for tid in tenants:
+                churn(tid, w)
+            submit_all(w)
+            for _tid, r in service.pump():
+                placements += r.stats.pods_placed
+                wave_results.append(
+                    {"backend": r.stats.backend,
+                     "placed": r.stats.pods_placed}
+                )
+        for _tid, r in service.flush():
+            placements += r.stats.pods_placed
+            wave_results.append(
+                {"backend": r.stats.backend,
+                 "placed": r.stats.pods_placed}
+            )
+    block_s = time.perf_counter() - t_block
+    dispatches = service.dispatcher.dispatches - dispatches_before
+    assert dispatches >= 3, dispatches
+    assert all(r["backend"] == "dense_service" for r in wave_results)
+    recompiles = counter.count if counter.supported else -1
+    if counter.supported:
+        assert recompiles == 0, (
+            f"{recompiles} steady-state recompiles across "
+            f"{dispatches} service dispatches"
+        )
+
+    agg_pods_per_sec = placements / block_s
+    per_tenant_p99 = {
+        t: round(float(np.percentile(v, 99)), 3)
+        for t, v in lat.items()
+    }
+    per_wave_placed = placements / n_waves
+    service_wave_ms = block_s * 1000 / n_waves
+
+    # ---- serial one-tenant-at-a-time counterfactual, measured -------
+    # N serial schedulers on this same device facing the SAME churn
+    # stream: each tenant churned then scheduled alone (its own
+    # dispatch, its own sanctioned fetch, nothing to batch against),
+    # warm like the batched waves were. Known small bias AGAINST the
+    # serial lane: the dispatcher's grow-only batch-axis floor makes
+    # each one-tenant chunk stack/upload a b_floor-wide (<= the wave
+    # width) zero-padded CHANNEL-table tree — a few hundred KB of host
+    # memcpy + upload per tenant, no extra dense tables and no extra
+    # dispatches (padding slots never dispatch). Clearing the floor
+    # instead would recompile the member kernel for a batch-of-1 shape
+    # and bill the serial lane whole compiles, a far larger bias.
+    t0 = time.perf_counter()
+    serial_placed = 0
+    for tid in tenants:
+        churn(tid, n_waves)
+        service.submit(tid)
+        service.pump()
+        for _t, r in service.flush():
+            serial_placed += r.stats.pods_placed
+            assert r.stats.backend == "dense_service", (
+                tid, r.stats.backend
+            )
+    serial_dense_s = time.perf_counter() - t0
+    # the REFERENCE architecture's counterfactual: one external
+    # cs2-class solver invocation per cluster, serially (reported, not
+    # gated — at small per-tenant scale the subprocess oracle is quick;
+    # at flagship scale it loses 10-90x, PERF.md "The solver")
+    serial_oracle_s = 0.0
+    for tid in tenants:
+        solver = service.sessions[tid].solver
+        net = FlowNetwork.from_arrays(
+            solver.last_arrays["src"], solver.last_arrays["dst"],
+            solver.last_arrays["cap"], solver.last_cost_host,
+            solver.last_arrays["supply"],
+        )
+        t0 = time.perf_counter()
+        solve_oracle(net, algorithm="cost_scaling")
+        serial_oracle_s += time.perf_counter() - t0
+    speedup_vs_serial = (serial_dense_s * 1000) / service_wave_ms
+    # the >= 3x aggregate-throughput gate is live in the lane's target
+    # regime — a linked accelerator whose measured per-sync floor makes
+    # N serial fetches the dominant serial cost (~100 ms flat on this
+    # environment's tunnel, BENCH device rounds). On a zero-floor host
+    # the two lanes are the same compute by construction; batching must
+    # still never lose materially.
+    if sync_floor_ms >= 5.0:
+        assert speedup_vs_serial >= 3.0, (
+            f"aggregate throughput only {speedup_vs_serial:.2f}x the "
+            f"serial one-tenant-at-a-time counterfactual (need >= 3x "
+            f"with a {sync_floor_ms:.0f} ms measured sync floor)"
+        )
+    else:
+        assert speedup_vs_serial >= 0.75, (
+            f"batched wave {speedup_vs_serial:.2f}x serial on a "
+            f"zero-sync-floor host: batching must not lose throughput"
+        )
+
+    # ---- bit-identity: one tenant per bucket, cold vs cold ----------
+    buckets_seen: dict[tuple, str] = {}
+    for tid in tenants:
+        ctx = service.dispatcher.pool.context(tid)
+        buckets_seen.setdefault((ctx.t_floor, ctx.m_floor), tid)
+    verify_tenants = list(buckets_seen.values())
+    for tid in verify_tenants:
+        service.dispatcher.pool.invalidate(tid)
+    submit_all(99)
+    service.pump()
+    service.flush()
+    bit_identical = 0
+    for tid in verify_tenants:
+        solver = service.sessions[tid].solver
+        res, _ = solve_transport_dense(solver.last_instance)
+        assert res.converged
+        assert np.array_equal(solver.last_assignment, res.assignment), (
+            f"tenant {tid}: bucketed cold solve != solo solve"
+        )
+        bit_identical += 1
+
+    return {
+        "config": "service_multi_tenant",
+        "n_tenants": n_tenants,
+        "buckets": len(buckets_seen),
+        "measured_waves": n_waves,
+        "dispatches": int(dispatches),
+        "placements_total": int(placements),
+        "placements_per_wave": round(per_wave_placed, 1),
+        "aggregate_pods_per_sec": round(agg_pods_per_sec, 1),
+        "service_wave_ms": round(service_wave_ms, 3),
+        # headline alias for solo --configs=11 runs (main's fallback)
+        "solve_p50_ms": round(service_wave_ms, 3),
+        "per_tenant_p99_ms": per_tenant_p99,
+        "per_tenant_p99_max_ms": round(
+            max(per_tenant_p99.values()), 3
+        ),
+        "serial_oracle_ms": round(serial_oracle_s * 1000, 3),
+        "serial_dense_ms": round(serial_dense_s * 1000, 3),
+        "speedup_vs_serial": round(speedup_vs_serial, 2),
+        "sync_floor_ms": sync_floor_ms,
+        "speedup_gate": (
+            ">=3x (linked-accelerator regime)"
+            if sync_floor_ms >= 5.0 else
+            ">=0.75x no-regression (zero-sync-floor host)"
+        ),
+        "bit_identity_verified_tenants": bit_identical,
+        "steady_state_recompiles": recompiles,
+        "exact": True,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--configs",
-        default="1,2,3,4,5,6,7,8,9,10",
+        default="1,2,3,4,5,6,7,8,9,10,11",
         help="comma list of BASELINE config numbers to run "
              "(6 = the rebalancing drift-correction config, "
              "7 = observe-phase poll vs watch, "
@@ -1751,7 +2039,11 @@ def main() -> int:
              "shape via the between-ticks express lane, "
              "10 = observability_overhead: flagship churned-warm p50 "
              "with the full metrics+span surface on vs off, <2% "
-             "asserted)",
+             "asserted, "
+             "11 = service_multi_tenant: 8 heterogeneous tenant "
+             "clusters batched into one device pipeline — aggregate "
+             "pods/sec + per-tenant p99 vs N serial schedulers, "
+             "bit-identity + zero-steady-state-recompiles asserted)",
     )
     ap.add_argument("--solve-reps", type=int, default=20)
     ap.add_argument("--oracle-reps", type=int, default=3)
@@ -1856,6 +2148,22 @@ def main() -> int:
                 rows.append(
                     {"config": "observability_overhead",
                      "config_num": 10, "error": True}
+                )
+            continue
+        if num == 11:
+            log("bench: running config 11 (service_multi_tenant) ...")
+            try:
+                row = bench_service(
+                    sync_floor_ms=tunnel.get("sync_floor_ms", 0.0)
+                )
+                row["config_num"] = 11
+                rows.append(row)
+                log(f"bench: config 11 done: {json.dumps(row)}")
+            except Exception:
+                log(f"bench: config 11 FAILED:\n{traceback.format_exc()}")
+                rows.append(
+                    {"config": "service_multi_tenant",
+                     "config_num": 11, "error": True}
                 )
             continue
         if num == 6:

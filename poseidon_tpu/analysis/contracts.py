@@ -51,6 +51,9 @@ _OBS_HOT_SCOPES = {
         "SchedulerMetrics.record_reconnect",
         "SchedulerMetrics.record_solver_round",
         "SchedulerMetrics.record_express_fetch",
+        "SchedulerMetrics.record_service_round",
+        "SchedulerMetrics.record_service_dispatch",
+        "SchedulerMetrics.record_service_compiles",
     ),
     "poseidon_tpu/obs/spans.py": (
         "round_span_tree",
@@ -144,6 +147,26 @@ DEFAULT_CONTRACTS = Contracts(
         "poseidon_tpu/parallel/sharded.py": (
             "resident_round_shardings",
         ),
+        # the service lane (multi-tenant batching): begin prices on the
+        # CPU backend (its fetch never crosses the device link, the one
+        # noqa'd site), launch does one explicit upload + per-member
+        # dispatches, finish joins the chunk's ONE sanctioned batched
+        # fetch — no other host sync may slip into the dispatch window
+        "poseidon_tpu/service/dispatch.py": (
+            "TenantSolver.begin_round",
+            "TenantSolver.finish_round",
+            "BatchDispatcher.register",
+            "BatchDispatcher.launch",
+            "BatchDispatcher._launch_chunk",
+            "BatchDispatcher.finish",
+        ),
+        # the front door pipeline: pure host bookkeeping (queues,
+        # futures, stats) — never a device call of its own
+        "poseidon_tpu/service/service.py": (
+            "SchedulingService.pump",
+            "SchedulingService._finish_wave",
+            "SchedulingService._account",
+        ),
         # observability recording + span assembly (_OBS_HOT_SCOPES):
         # pure host arithmetic on values the caller already fetched,
         # never a new device sync
@@ -159,6 +182,7 @@ DEFAULT_CONTRACTS = Contracts(
         "_express_chain",
         "_express_patch",
         "_solve",
+        "_solve_member",
         "_densify",
         "cold_start",
         "model_fn",
@@ -185,6 +209,22 @@ DEFAULT_CONTRACTS = Contracts(
             "ResidentSolver.begin_round",
             "ResidentSolver.finish_round",
             "ResidentSolver.express_round",
+        ),
+        # the service dispatch/pipeline scopes run once per WAVE across
+        # N tenants: an O(tenants x cluster) host walk there turns the
+        # batched lane back into N serial schedulers
+        "poseidon_tpu/service/dispatch.py": (
+            "TenantSolver.begin_round",
+            "TenantSolver.finish_round",
+            "BatchDispatcher.register",
+            "BatchDispatcher.launch",
+            "BatchDispatcher._launch_chunk",
+            "BatchDispatcher.finish",
+        ),
+        "poseidon_tpu/service/service.py": (
+            "SchedulingService.pump",
+            "SchedulingService._finish_wave",
+            "SchedulingService._account",
         ),
         # aggregation planning/expansion must stay vectorized numpy:
         # a Python walk over machines here is O(cluster) every round

@@ -284,6 +284,7 @@ class SchedulerBridge:
         express_max_batch: int = 16,
         metrics=None,
         profile_spans: bool = False,
+        solver=None,
     ):
         self.cost_model = cost_model
         self.max_tasks_per_machine = max_tasks_per_machine
@@ -312,8 +313,12 @@ class SchedulerBridge:
         # across rounds (the reference's --run_incremental_scheduler
         # seam). The scale lane rides here too: mesh_width shards the
         # round's task axis over a device mesh, aggregate_classes/
-        # topk_prefs shrink the machine/pref axes (graph/aggregate.py)
-        self.solver = ResidentSolver(
+        # topk_prefs shrink the machine/pref axes (graph/aggregate.py).
+        # ``solver`` injects a different implementation of the same
+        # begin/finish seam — the multi-tenant service routes every
+        # tenant bridge through a shared batching dispatcher this way
+        # (service/dispatch.TenantSolver); None = own ResidentSolver.
+        self.solver = solver if solver is not None else ResidentSolver(
             oracle_timeout_s=solver_timeout_s,
             small_to_oracle=small_to_oracle,
             mesh_width=mesh_width,

@@ -189,6 +189,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "while the express context is live (1 = every "
                         "tick); a degraded/invalidated express context "
                         "forces the round on the next tick regardless")
+    # scheduling as a service: one daemon, N tenant clusters. Every
+    # tenant keeps a fully isolated bridge/stats/trace/decision-log;
+    # their round solves pad into shape buckets and dispatch as ONE
+    # batched device program with ONE batched fetch per bucket chunk
+    # (poseidon_tpu/service/). The reference's ceiling is one cluster
+    # per deployment (one process + one Firmament per apiserver);
+    # this is the one-TPU-many-clusters inversion of that.
+    p.add_argument("--serve",
+                   default="false", choices=["true", "false"],
+                   help="multi-tenant service mode: schedule N tenant "
+                        "clusters (from --serve_apiservers or "
+                        "--serve_tenants fakes) through one batched "
+                        "device pipeline; per-tenant state/trace/"
+                        "decision logs stay isolated")
+    p.add_argument("--serve_apiservers", default="",
+                   help="comma list of tenant apiserver host:port "
+                        "endpoints for --serve (one tenant each)")
+    p.add_argument("--serve_tenants", type=int, default=0,
+                   help="with --serve and no --serve_apiservers: spin "
+                        "up N in-process fake-apiserver tenants with "
+                        "heterogeneous synthetic workloads (demo/"
+                        "smoke mode)")
+    p.add_argument("--serve_max_batch", type=int, default=64,
+                   help="max tenant instances per batched bucket "
+                        "dispatch; the HBM budget may split a wave "
+                        "into smaller chunks regardless (each chunk "
+                        "is one upload + one batched fetch)")
     p.add_argument("--max_solver_runtime", type=int,
                    default=1_000_000_000,
                    help="microseconds; bounds one oracle-fallback solve "
@@ -731,6 +758,10 @@ def run_loop(args: argparse.Namespace) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     args = parse_args(sys.argv[1:] if argv is None else argv)
+    if args.serve == "true":
+        from poseidon_tpu.service.serve import run_serve
+
+        return run_serve(args)
     return run_loop(args)
 
 

@@ -365,6 +365,39 @@ class SchedulerMetrics:
             "the /readyz latch: 1 after seed LIST + first round over "
             "real state (certified solve or proven-empty)",
         )
+        # ---- the service lane (multi-tenant batching, service/) ----
+        # tenant labels are BOUNDED at the service layer: the first
+        # service.MAX_TENANT_LABELS registered tenants keep their id,
+        # later ones collapse into "other" (finite series forever)
+        self.service_rounds = registry.counter(
+            "poseidon_service_rounds_total",
+            "service-lane tenant rounds completed, by (bounded) tenant",
+        )
+        self.service_round_ms = registry.histogram(
+            "poseidon_service_round_ms",
+            "per-tenant submit-to-result round latency in the service "
+            "lane, by (bounded) tenant",
+        )
+        self.service_placements = registry.counter(
+            "poseidon_service_placements_total",
+            "pods placed across ALL tenants by the service lane (the "
+            "aggregate pods/sec numerator)",
+        )
+        self.service_dispatches = registry.counter(
+            "poseidon_service_dispatches_total",
+            "batched bucket dispatches (one upload + one batched "
+            "fetch each), by bucket shape TpxMpxP",
+        )
+        self.service_bucket_occupancy = registry.gauge(
+            "poseidon_service_bucket_occupancy",
+            "tenant instances in the most recent dispatch of each "
+            "bucket shape",
+        )
+        self.service_compiles = registry.counter(
+            "poseidon_service_compiles_total",
+            "XLA compiles triggered by service launches (nonzero only "
+            "during warmup / bucket growth; 0 in steady state)",
+        )
         # degraded-gauge bookkeeping: whys currently set to 1, so a
         # recovery round can clear exactly what an earlier round set
         self._degraded_whys: set[str] = set()
@@ -478,6 +511,29 @@ class SchedulerMetrics:
 
     def record_express_fetch(self) -> None:
         self.solver_fetches.inc(lane="express")
+
+    # ---- the service lane ----------------------------------------------
+
+    def record_service_round(
+        self, tenant: str, total_ms: float, placed: int
+    ) -> None:
+        """One tenant round finished by the service pipeline: the
+        submit-to-result latency and its placement count (host values
+        the service already computed; ``tenant`` is pre-bounded)."""
+        self.service_rounds.inc(tenant=tenant)
+        self.service_round_ms.observe(total_ms, tenant=tenant)
+        self.service_placements.inc(placed)
+
+    def record_service_dispatch(
+        self, bucket: str, occupancy: int
+    ) -> None:
+        """One batched bucket dispatch (one upload + one batched
+        fetch): its shape key and how many tenant instances rode it."""
+        self.service_dispatches.inc(bucket=bucket)
+        self.service_bucket_occupancy.set(occupancy, bucket=bucket)
+
+    def record_service_compiles(self, compiles: int) -> None:
+        self.service_compiles.inc(compiles)
 
 
 # express degrade reasons are free text (they embed uids/counts);

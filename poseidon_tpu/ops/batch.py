@@ -33,13 +33,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from poseidon_tpu.compat import enable_x64
+from poseidon_tpu.graph.network import pad_bucket
 from poseidon_tpu.ops.dense_auction import (
     I32,
     INF,
     DenseInstance,
+    _densify,
     _solve,
     build_dense_instance,
+    build_member_tables,
+    check_table_budget,
     cold_start,
+    default_fuse,
+    member_side_ints,
 )
 from poseidon_tpu.ops.transport import TransportInstance
 
@@ -227,4 +233,217 @@ def solve_what_if(
         converged=np.asarray(conv, bool),
         assignments=asg_np,
         rounds=np.asarray(rounds, np.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the heterogeneous lane: N DIFFERENT instances, one batch, one fetch
+# ---------------------------------------------------------------------------
+#
+# The what-if lane above batches VARIANTS of one graph (shared topology,
+# perturbed costs). The service lane (poseidon_tpu/service/) batches
+# whole independent cluster instances — distinct task/machine counts,
+# cost models, preference structures — padded to a shared (Tp, Mp, P)
+# shape bucket. Everything is stacked host-side into [B, ...] channel
+# tables (NOT the dense [B, Tp, Mp] table: densify runs on device per
+# member, so the upload is O(B * (Tp * P + Mp)) instead of
+# O(B * Tp * Mp)), uploaded in ONE device_put, solved by per-member
+# dispatches of ``_solve_member`` (the same economics as
+# ``_solve_variant``: independent pipelined dispatches of the
+# single-instance kernel, NOT a vmapped lockstep ladder — see the
+# module docstring), and read back in ONE batched device_get.
+#
+# Exactness: a member's in-bucket solve is the SAME function as its
+# solo ``solve_transport_dense`` whenever the padded dims agree —
+# identical scaling, identical densify, identical cold start, identical
+# eps ladder. The two deliberate bucket-level static knobs cannot
+# change results: extra all-absent preference columns are skipped
+# masks in ``_densify``, and ``smax`` only widens the top_k clearing
+# window (the s_m-th highest value is read by index, so any
+# smax >= max slots yields the same clearing price). tests/
+# test_service.py pins bit-identity across cost models and shape mixes.
+
+# host channel-table vocabulary for one padded bucket member, in
+# stacking order (every entry is one np array; bool for task_valid)
+MEMBER_KEYS = (
+    "u", "w", "d", "ra", "rack_of", "slots", "pc", "pm", "pr",
+    "task_valid", "scale", "cmax",
+)
+
+
+def member_bucket_dims(
+    inst: TransportInstance, *, t_min: int = 16, m_min: int = 16,
+    p_min: int = 0,
+) -> tuple[int, int, int]:
+    """(Tp, Mp, P) padding dims for one instance under grow-only floors
+    (the same ``pad_bucket`` ladder ``build_dense_instance`` uses, so a
+    fresh-floor member pads exactly like its solo solve would)."""
+    Tp = pad_bucket(max(inst.n_tasks, 1), minimum=t_min)
+    Mp = pad_bucket(max(inst.n_machines, 1), minimum=m_min)
+    return Tp, Mp, max(inst.max_prefs, p_min)
+
+
+def stack_members(
+    members: list[dict[str, np.ndarray]], Bp: int
+) -> dict[str, np.ndarray]:
+    """Stack member channel tables into one [Bp, ...] host tree.
+
+    ``Bp >= len(members)`` is the batch-axis padding bucket (grow-only
+    at the dispatcher, so a churning tenant count keeps one compiled
+    shape); padding slots are zero-filled and NEVER dispatched — only
+    real member indices are sliced on device.
+    """
+    B = len(members)
+    if B == 0 or B > Bp:
+        raise ValueError(f"{B} members do not fit batch bucket {Bp}")
+    out = {}
+    for k in MEMBER_KEYS:
+        first = np.asarray(members[0][k])
+        stacked = np.zeros((Bp,) + first.shape, first.dtype)
+        for i, m in enumerate(members):
+            stacked[i] = m[k]
+        out[k] = stacked
+    return out
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_prefs", "smax", "alpha", "max_rounds", "warm_start",
+    ),
+)
+def _solve_member(
+    u, w, d, ra, rack_of, slots, pc, pm, pr, task_valid, scale, cmax,
+    b, warm_asg, warm_lvl, warm_floor,
+    *,
+    n_prefs: int,
+    smax: int,
+    alpha: int,
+    max_rounds: int,
+    warm_start: bool,
+):
+    """Bucket member ``b``'s full certified solve over the stacked
+    channel tables: device-side densify + the unchanged ``_solve``
+    eps-ladder + exact scaled objective. Compiled once per (bucket
+    shape, warm/cold); dispatched per member back-to-back with no host
+    syncs between — the caller fetches every member's result in one
+    device_get. ``warm_start`` runs the eps=1 settle from the member's
+    previous DenseState (the per-tenant warm context); cold runs the
+    full analytic ladder, bit-identical to ``solve_transport_dense``
+    at the same padded dims."""
+    def one(x):
+        return jax.lax.dynamic_index_in_dim(x, b, keepdims=False)
+
+    u1, w1 = one(u), one(w)
+    d1, ra1 = one(d), one(ra)
+    rk1, s1 = one(rack_of), one(slots)
+    pc1, pm1, pr1 = one(pc), one(pm), one(pr)
+    tv1 = one(task_valid)
+    sc1, cm1 = one(scale), one(cmax)
+    Mp = d1.shape[0]
+
+    c1 = _densify(w1, d1, ra1, rk1, s1, pc1, pm1, pr1, n_prefs=n_prefs)
+    dev = DenseInstance(
+        c=c1, u=u1, w=w1, dgen=d1, s=s1, task_valid=tv1,
+        scale=sc1, cmax=cm1, smax=smax,
+    )
+    if warm_start:
+        asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
+            dev, warm_asg, warm_lvl, warm_floor, jnp.int32(1),
+            alpha=alpha, max_rounds=max_rounds, smax=smax,
+            analytic_init=False,
+        )
+    else:
+        asg0, lvl0, floor0, eps0 = cold_start(dev, alpha)
+        asg, lvl, floor, gap, converged, rounds, phases, _ = _solve(
+            dev, asg0, lvl0, floor0, eps0, alpha=alpha,
+            max_rounds=max_rounds, smax=smax, analytic_init=True,
+        )
+    # exact scaled objective of the member's assignment
+    on_m = (asg >= 0) & (asg < Mp)
+    c_asg = jnp.take_along_axis(
+        c1, jnp.clip(asg, 0, Mp - 1)[:, None], axis=1
+    )[:, 0]
+    per_task = jnp.where(on_m, c_asg, jnp.where(asg == Mp, u1, 0))
+    cost = jnp.sum(
+        jnp.where(tv1, per_task, 0).astype(jnp.int64)
+    )
+    return cost, converged, asg, rounds, lvl, floor, gap, phases
+
+
+def solve_heterogeneous(
+    instances: list[TransportInstance],
+    *,
+    alpha: int = 1024,
+    max_rounds: int | None = None,
+) -> BatchResult:
+    """Solve N heterogeneous instances padded to ONE shape bucket: one
+    upload, per-member pipelined dispatches, one batched fetch.
+
+    The convenience form of the service lane for tests and one-shot
+    sweeps: bucket dims are the max over members' natural pads, every
+    member solves cold, and results come back host-side. The production
+    path (``service/dispatch.py``) adds per-tenant warm contexts,
+    grow-only floors, chunking against the HBM budget, and the async
+    fetch — but runs this exact kernel.
+    """
+    if not instances:
+        return BatchResult(
+            costs=np.zeros(0, np.int64),
+            converged=np.zeros(0, bool),
+            assignments=np.zeros((0, 0), np.int32),
+            rounds=np.zeros(0, np.int32),
+        )
+    if max_rounds is None:
+        max_rounds = default_fuse()
+    dims = [member_bucket_dims(i) for i in instances]
+    Tp = max(t for t, _, _ in dims)
+    Mp = max(m for _, m, _ in dims)
+    P = max(p for _, _, p in dims)
+    B = len(instances)
+    members = [build_member_tables(i, Tp, Mp, P) for i in instances]
+    check_table_budget(
+        Tp, Mp, B, side_ints_per_variant=member_side_ints(Tp, Mp, P),
+    )
+    smax = max(
+        max(min(int(np.max(m["slots"], initial=0)), Tp), 1)
+        for m in members
+    )
+    stacked = jax.device_put(stack_members(members, B))
+    zeros_t = jnp.zeros(Tp, I32)
+    zeros_m = jnp.zeros(Mp, I32)
+    with enable_x64(True):
+        outs = [
+            _solve_member(
+                *(stacked[k] for k in MEMBER_KEYS), jnp.int32(b),
+                zeros_t, zeros_t, zeros_m,
+                n_prefs=P, smax=smax, alpha=alpha,
+                max_rounds=max_rounds, warm_start=False,
+            )
+            for b in range(B)
+        ]
+    # ONE batched fetch for every member (each separate device_get
+    # pays this environment's flat per-sync charge)
+    fetched = jax.device_get(
+        [(cost, conv, asg, rounds) for cost, conv, asg, rounds, *_ in outs]
+    )
+    Tmax = max(i.n_tasks for i in instances)
+    asg_out = np.full((B, Tmax), -1, np.int32)
+    costs = np.zeros(B, np.int64)
+    convs = np.zeros(B, bool)
+    rnds = np.zeros(B, np.int32)
+    for b, (inst, (cost, conv, asg, rounds)) in enumerate(
+        zip(instances, fetched)
+    ):
+        T = inst.n_tasks
+        a = np.asarray(asg, np.int32)[:T]
+        a = np.where(
+            (a >= 0) & (a < inst.n_machines), a, -1
+        ).astype(np.int32)
+        asg_out[b, :T] = a
+        costs[b] = np.asarray(cost, np.int64) // (T + 1)
+        convs[b] = bool(conv)
+        rnds[b] = int(rounds)
+    return BatchResult(
+        costs=costs, converged=convs, assignments=asg_out, rounds=rnds,
     )

@@ -135,6 +135,26 @@ def _budget_need(
         + extra_ints * 4
 
 
+def max_variants_for(
+    Tp: int, Mp: int, side_ints_per_variant: int = 0,
+    extra_ints: int = 0, mesh_width: int = 1,
+) -> int:
+    """Largest ``n_variants`` (batch / bucket width) of this [Tp, Mp]
+    shape that fits the per-device HBM budget; 0 if even one instance
+    does not fit. The batched lanes (what-if variants, the service's
+    shape-bucket dispatcher) size their chunks with this so an oversize
+    wave splits into fitting dispatches instead of raising."""
+    base = _budget_need(
+        Tp, Mp, 0, side_ints_per_variant, extra_ints, mesh_width
+    )
+    per = _budget_need(
+        Tp, Mp, 1, side_ints_per_variant, extra_ints, mesh_width
+    ) - base
+    if per <= 0:
+        return 0
+    return max((DENSE_TABLE_BUDGET_BYTES - base) // per, 0)
+
+
 def check_table_budget(
     Tp: int, Mp: int, n_variants: int = 1,
     side_ints_per_variant: int = 0, extra_ints: int = 0,
@@ -145,7 +165,8 @@ def check_table_budget(
 
     ``side_ints_per_variant`` counts per-variant i32 arrays beyond the
     main table (the what-if batch carries perturbed u[Tp] / w[Tp] /
-    dgen[Mp] side tables alongside each c[Tp, Mp]); ``extra_ints``
+    dgen[Mp] side tables alongside each c[Tp, Mp]; the service lane's
+    bucket members carry their channel tables); ``extra_ints``
     counts one-off i32 scratch (the perturb kernel's generic/pref-part
     [Tp, Mp] intermediates). Both default to 0 so the single-instance
     estimate is exactly the main table. ``mesh_width`` is the task-axis
@@ -153,11 +174,13 @@ def check_table_budget(
     shrinks to Tp/width rows, which is the whole point of sharding the
     round.
 
-    An overflow's message is ACTIONABLE, not just diagnostic: it names
-    the smallest mesh width that would fit this shape, and the
-    aggregation settings (--aggregate_classes / --topk_prefs) that
-    shrink the machine axis to its equivalence classes — the two scale
-    attacks the operator can actually turn on.
+    An overflow's message is ACTIONABLE, not just diagnostic: for a
+    batched shape (n_variants > 1) it names the largest batch width /
+    ``n_variants`` that WOULD fit, and for every shape it names the
+    smallest mesh width that would fit plus the aggregation settings
+    (--aggregate_classes / --topk_prefs) that shrink the machine axis
+    to its equivalence classes — the escapes the operator can actually
+    turn on.
     """
     need = _budget_need(
         Tp, Mp, n_variants, side_ints_per_variant, extra_ints,
@@ -165,6 +188,17 @@ def check_table_budget(
     )
     if need <= DENSE_TABLE_BUDGET_BYTES:
         return
+    batch_hint = ""
+    if n_variants > 1:
+        fit_b = max_variants_for(
+            Tp, Mp, side_ints_per_variant, extra_ints, mesh_width
+        )
+        if fit_b >= 1:
+            batch_hint = (
+                f"the largest batch of this shape that fits is "
+                f"n_variants <= {fit_b} (shrink the what-if batch / "
+                f"service bucket width, --serve_max_batch); "
+            )
     fit_w = max(mesh_width, 1)
     while fit_w < 1024 and _budget_need(
         Tp, Mp, n_variants, side_ints_per_variant, extra_ints, fit_w
@@ -185,7 +219,7 @@ def check_table_budget(
         f"{extra_ints} scratch ints, mesh width {max(mesh_width, 1)}) "
         f"= {need >> 20} MiB/device exceeds the "
         f"{DENSE_TABLE_BUDGET_BYTES >> 20} MiB budget "
-        f"(POSEIDON_TPU_DENSE_TABLE_BUDGET_MB); {mesh_hint}; "
+        f"(POSEIDON_TPU_DENSE_TABLE_BUDGET_MB); {batch_hint}{mesh_hint}; "
         f"--aggregate_classes collapses the machine axis to its "
         f"equivalence classes (add --topk_prefs=K to cap preference "
         f"columns), typically orders of magnitude fewer columns"
@@ -254,14 +288,35 @@ def _densify(
     return c
 
 
-def build_dense_instance(inst: TransportInstance) -> DenseInstance:
-    """Scale + pad a host TransportInstance and densify it on device."""
-    T, M, P = inst.n_tasks, inst.n_machines, inst.max_prefs
-    Tp = pad_bucket(max(T, 1))
-    Mp = pad_bucket(max(M, 1))
-    check_table_budget(Tp, Mp)
-    scale = np.int64(T + 1)
+def member_side_ints(Tp: int, Mp: int, P: int) -> int:
+    """Per-instance i32 side tables beyond the dense [Tp, Mp] solve
+    table, in the channel-table form ``build_member_tables`` produces:
+    u/w/task_valid (Tp each), d/ra/rack_of/slots (Mp each), pc/pm/pr
+    (Tp x P each) — what the batched budget accounting charges each
+    what-if variant / service bucket member."""
+    return 3 * Tp + 4 * Mp + 3 * Tp * max(P, 1)
 
+
+def build_member_tables(
+    inst: TransportInstance, Tp: int, Mp: int, P: int
+) -> dict[str, np.ndarray]:
+    """Scale + pad one instance's CHANNEL tables to (Tp, Mp, P),
+    host-side — the single source of the scale-and-pad step shared by
+    the solo lane (``build_dense_instance`` densifies this dict on
+    device) and the batched lanes (ops/batch.py stacks B of them).
+    Sharing one implementation is load-bearing: the service's
+    bit-identity guarantee (bucketed solve == solo solve) holds
+    because both lanes pad with exactly these fills and guards.
+    Raises ``CostDomainTooLarge`` / ``ValueError`` per the kernel
+    envelope.
+    """
+    T = inst.n_tasks
+    if T > Tp or inst.n_machines > Mp or inst.max_prefs > P:
+        raise ValueError(
+            f"instance ({T} x {inst.n_machines}, {inst.max_prefs} "
+            f"prefs) does not fit bucket ({Tp} x {Mp}, {P} prefs)"
+        )
+    scale = np.int64(T + 1)
     cmax = 0
     for arr in (inst.u, inst.w, inst.pref_cost, inst.d, inst.ra):
         a = np.asarray(arr, np.int64)
@@ -290,38 +345,55 @@ def build_dense_instance(inst: TransportInstance) -> DenseInstance:
         out[: v.shape[0], : v.shape[1]] = v
         return out
 
-    u = pad1(_sc(inst.u, scale), Tp, 0)
-    w = pad1(_sc(inst.w, scale), Tp, INF)
-    d = pad1(_sc(inst.d, scale), Mp, INF)
-    ra = pad1(_sc(inst.ra, scale), Mp, INF)
-    rack_of = pad1(inst.rack_of, Mp, -1)
-    slots = pad1(inst.slots, Mp, 0)
-    if P:
-        pc = pad2(_sc(inst.pref_cost, scale), (Tp, P), INF)
-        pm = pad2(inst.pref_machine, (Tp, P), -1)
-        pr = pad2(inst.pref_rack, (Tp, P), -1)
+    Pw = max(P, 1)
+    if inst.max_prefs:
+        pc = pad2(_sc(inst.pref_cost, scale), (Tp, Pw), INF)
+        pm = pad2(inst.pref_machine, (Tp, Pw), -1)
+        pr = pad2(inst.pref_rack, (Tp, Pw), -1)
     else:
-        pc = np.full((Tp, 1), INF, np.int32)
-        pm = np.full((Tp, 1), -1, np.int32)
-        pr = np.full((Tp, 1), -1, np.int32)
-    task_valid = np.arange(Tp) < T
+        pc = np.full((Tp, Pw), INF, np.int32)
+        pm = np.full((Tp, Pw), -1, np.int32)
+        pr = np.full((Tp, Pw), -1, np.int32)
+    return {
+        "u": pad1(_sc(inst.u, scale), Tp, 0),
+        "w": pad1(_sc(inst.w, scale), Tp, INF),
+        "d": pad1(_sc(inst.d, scale), Mp, INF),
+        "ra": pad1(_sc(inst.ra, scale), Mp, INF),
+        "rack_of": pad1(inst.rack_of, Mp, -1),
+        "slots": pad1(inst.slots, Mp, 0),
+        "pc": pc,
+        "pm": pm,
+        "pr": pr,
+        "task_valid": np.arange(Tp) < T,
+        "scale": np.int32(scale),
+        "cmax": np.int32(min(cmax_scaled, int(INF) - 1)),
+    }
 
+
+def build_dense_instance(inst: TransportInstance) -> DenseInstance:
+    """Scale + pad a host TransportInstance and densify it on device."""
+    T, M, P = inst.n_tasks, inst.n_machines, inst.max_prefs
+    Tp = pad_bucket(max(T, 1))
+    Mp = pad_bucket(max(M, 1))
+    check_table_budget(Tp, Mp)
+    t = build_member_tables(inst, Tp, Mp, P)
     c = _densify(
-        jnp.asarray(w), jnp.asarray(d), jnp.asarray(ra),
-        jnp.asarray(rack_of), jnp.asarray(slots), jnp.asarray(pc),
-        jnp.asarray(pm), jnp.asarray(pr),
+        jnp.asarray(t["w"]), jnp.asarray(t["d"]), jnp.asarray(t["ra"]),
+        jnp.asarray(t["rack_of"]), jnp.asarray(t["slots"]),
+        jnp.asarray(t["pc"]), jnp.asarray(t["pm"]),
+        jnp.asarray(t["pr"]),
         n_prefs=P,
     )
     return DenseInstance(
         c=c,
-        u=jnp.asarray(u),
-        w=jnp.asarray(w),
-        dgen=jnp.asarray(d),
-        s=jnp.asarray(slots),
-        task_valid=jnp.asarray(task_valid),
-        scale=jnp.int32(scale),
-        cmax=jnp.int32(min(cmax_scaled, int(INF) - 1)),
-        smax=max(min(int(np.max(slots, initial=0)), Tp), 1),
+        u=jnp.asarray(t["u"]),
+        w=jnp.asarray(t["w"]),
+        dgen=jnp.asarray(t["d"]),
+        s=jnp.asarray(t["slots"]),
+        task_valid=jnp.asarray(t["task_valid"]),
+        scale=jnp.int32(t["scale"]),
+        cmax=jnp.int32(t["cmax"]),
+        smax=max(min(int(np.max(t["slots"], initial=0)), Tp), 1),
     )
 
 

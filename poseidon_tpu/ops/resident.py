@@ -784,6 +784,97 @@ class _ExpressContext:
 _EXPRESS_PATCH_CHUNK = 1024
 
 
+# ---------------------------------------------------------------------------
+# per-tenant warm contexts (the service lane, poseidon_tpu/service/)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TenantContext:
+    """One tenant's warm solve context in the multi-tenant service.
+
+    The per-tenant analog of ``ResidentSolver``'s warm handle + grow-
+    only padding floors: ``state`` is the tenant's on-HBM ``DenseState``
+    from its last certified in-bucket solve (asg/lvl/floor feed the
+    next dispatch's eps=1 warm settle), valid only while the tenant's
+    padded dims stay (Tp, Mp). The floors are the anti-recompile
+    hysteresis — a tenant whose task/arc counts oscillate across a fine
+    bucket boundary must not flip its shape bucket (and recompile the
+    member kernel) every other round.
+    """
+
+    state: DenseState | None = None
+    Tp: int = 0
+    Mp: int = 0
+    # grow-only bucket floors (reset by the pool on budget overflow,
+    # mirroring ResidentSolver's reset-on-DenseMemoryTooLarge)
+    e_floor: int = 16     # arc-count bucket (cost-input pricing pad)
+    t_floor: int = 16     # task-axis padding bucket
+    m_floor: int = 16     # machine-axis padding bucket
+    p_floor: int = 0      # preference-column floor
+    s_floor: int = 1      # smax (max free slots) floor
+    ti_floor: int = 1     # build_cost_inputs_host per-task pad
+    mi_floor: int = 1     # build_cost_inputs_host per-machine pad
+
+
+class TenantWarmPool:
+    """Warm per-tenant contexts keyed by tenant id.
+
+    Owned by the service's ``BatchDispatcher``; single-threaded by
+    contract (every access happens on the service pump thread, like
+    the bridge). Nothing here touches the device — the pool only holds
+    references to device arrays the member solves produced.
+    """
+
+    def __init__(self) -> None:
+        self._ctx: dict[str, TenantContext] = {}
+
+    def context(self, tenant_id: str) -> TenantContext:
+        ctx = self._ctx.get(tenant_id)
+        if ctx is None:
+            ctx = TenantContext()
+            self._ctx[tenant_id] = ctx
+        return ctx
+
+    def warm(self, tenant_id: str, Tp: int, Mp: int) -> DenseState | None:
+        """The tenant's warm handle, or None when cold / the tenant
+        outgrew its padding bucket (shape change = cold solve, the same
+        silent fallback the resident lane makes)."""
+        ctx = self._ctx.get(tenant_id)
+        if ctx is None or ctx.state is None:
+            return None
+        if ctx.Tp != Tp or ctx.Mp != Mp:
+            return None
+        return ctx.state
+
+    def commit(
+        self, tenant_id: str, state: DenseState, Tp: int, Mp: int
+    ) -> None:
+        ctx = self.context(tenant_id)
+        ctx.state = state
+        ctx.Tp = Tp
+        ctx.Mp = Mp
+
+    def invalidate(self, tenant_id: str | None = None) -> None:
+        """Drop warm state (one tenant, or everyone when None). Floors
+        survive — invalidation means "next solve is cold", not "the
+        tenant shrank"."""
+        if tenant_id is not None:
+            ctx = self._ctx.get(tenant_id)
+            if ctx is not None:
+                ctx.state = None
+            return
+        for ctx in self._ctx.values():
+            ctx.state = None
+
+    def reset_floors(self, tenant_id: str) -> None:
+        """Budget-overflow escape: a floor raised by a past larger
+        cluster must not keep re-padding a fitting tenant over budget
+        forever (the cost is one recompile) — same rule as
+        ``ResidentSolver``'s DenseMemoryTooLarge path."""
+        self._ctx[tenant_id] = TenantContext()
+
+
 class ResidentSolver:
     """Owns the device-resident solve chain + warm state across rounds.
 

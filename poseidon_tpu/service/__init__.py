@@ -1,0 +1,28 @@
+"""Scheduling-as-a-service: batch N heterogeneous tenant clusters into
+one device program behind an async front door.
+
+- ``service.SchedulingService`` — the front door: per-tenant isolated
+  ``SchedulerBridge`` sessions, ``submit(tenant) -> Future``, and the
+  double-buffered ``pump()`` pipeline;
+- ``dispatch.BatchDispatcher`` / ``dispatch.TenantSolver`` — the shared
+  solver seam: shape-bucket routing, grow-only bucket floors, one
+  batched upload + per-member kernel dispatches + one batched fetch
+  per dispatch chunk (the ``ops/batch._solve_member`` kernel);
+- ``serve.run_serve`` — the cli ``--serve`` driver (N real or fake
+  tenant apiservers).
+"""
+
+from poseidon_tpu.service.dispatch import BatchDispatcher, TenantSolver
+from poseidon_tpu.service.service import (
+    MAX_TENANT_LABELS,
+    SchedulingService,
+    TenantSession,
+)
+
+__all__ = [
+    "BatchDispatcher",
+    "MAX_TENANT_LABELS",
+    "SchedulingService",
+    "TenantSession",
+    "TenantSolver",
+]

@@ -1,0 +1,510 @@
+"""The multi-tenant service lane (poseidon_tpu/service/).
+
+The load-bearing claims, each pinned here:
+
+- **Per-tenant exactness**: a tenant solved inside a padded shape
+  bucket (other tenants' instances stacked alongside) gets exactly the
+  bindings it would get solo — bit-identical assignments across >= 3
+  cost models, with preemption on and off, and across fuzzed shape
+  mixes within one bucket.
+- **Zero steady-state recompiles**: after warmup, waves of churning
+  tenant shapes dispatch with ZERO XLA compiles (grow-only bucket
+  floors, the CompileCounter budget from PR 8 applied to the service
+  loop).
+- **Isolation**: tenants share the device but nothing else — no
+  tenant's uid ever appears in another tenant's trace or decision log.
+- **Budget actionability**: a batched shape that blows the HBM budget
+  names the largest n_variants that would fit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.cluster import Task, TaskPhase
+from poseidon_tpu.guards import CompileCounter
+from poseidon_tpu.ops import dense_auction
+from poseidon_tpu.ops.batch import solve_heterogeneous
+from poseidon_tpu.ops.dense_auction import (
+    DenseMemoryTooLarge,
+    check_table_budget,
+    max_variants_for,
+    solve_transport_dense,
+)
+from poseidon_tpu.ops.transport import extract_instance
+from poseidon_tpu.service import SchedulingService
+from poseidon_tpu.synth import make_synthetic_cluster
+from tests.helpers import build_priced
+
+MODELS = ("quincy", "coco", "octopus")
+
+
+def _tenant_cluster(i: int, *, n_machines=None, n_tasks=None,
+                    running_fraction=0.0, seed=None, prefix=""):
+    """A small tenant cluster; defaults keep every tenant in the same
+    (32, 16) padding bucket while T/M stay heterogeneous. ``prefix``
+    namespaces uids/machines per tenant (the synth generator reuses
+    names, which would make cross-tenant isolation asserts vacuous)."""
+    import dataclasses as _dc
+
+    cluster = make_synthetic_cluster(
+        n_machines if n_machines is not None else 5 + (i % 4),
+        n_tasks if n_tasks is not None else 18 + 4 * (i % 4),
+        seed=seed if seed is not None else 1000 + i,
+        prefs_per_task=2,
+        running_fraction=running_fraction,
+    )
+    if not prefix:
+        return cluster
+    machines = [
+        _dc.replace(m, name=f"{prefix}{m.name}")
+        for m in cluster.machines
+    ]
+    tasks = [
+        _dc.replace(
+            t,
+            uid=f"{prefix}{t.uid}",
+            machine=f"{prefix}{t.machine}" if t.machine else "",
+            data_prefs={
+                (f"{prefix}{k}" if k.startswith("m") else k): v
+                for k, v in t.data_prefs.items()
+            },
+        )
+        for t in cluster.tasks
+    ]
+    return _dc.replace(cluster, machines=machines, tasks=tasks)
+
+
+def _feed(service, tid, cluster):
+    bridge = service.sessions[tid].bridge
+    bridge.observe_nodes(cluster.machines)
+    bridge.observe_pods(cluster.tasks)
+
+
+def _round_all(service, tenants):
+    """Submit one round for every tenant, run the pipeline to
+    completion, return {tenant: RoundResult}."""
+    futs = {t: service.submit(t) for t in tenants}
+    service.pump()
+    service.flush()
+    return {t: f.result(timeout=60) for t, f in futs.items()}
+
+
+class TestHeterogeneousKernel:
+    """ops/batch.solve_heterogeneous: the bucket kernel itself."""
+
+    def test_bit_identity_mixed_shapes_and_models(self):
+        rng = np.random.default_rng(7)
+        insts, solo = [], []
+        for shape, model in [((5, 18), "quincy"), ((7, 26), "coco"),
+                             ((8, 31), "octopus")]:
+            net, meta, _ = build_priced(rng, *shape, model=model)
+            inst = extract_instance(net, meta)
+            insts.append(inst)
+            solo.append(solve_transport_dense(inst)[0])
+        br = solve_heterogeneous(insts)
+        for b, (inst, res) in enumerate(zip(insts, solo)):
+            T = inst.n_tasks
+            assert bool(br.converged[b])
+            assert int(br.costs[b]) == res.cost
+            assert np.array_equal(br.assignments[b, :T], res.assignment)
+
+    def test_fuzz_shape_mix_within_bucket(self):
+        """Random tenant shapes (different natural pads mixed into one
+        max bucket) stay bit-identical to their solo solves."""
+        rng = np.random.default_rng(21)
+        for trial in range(3):
+            insts, solo = [], []
+            for k in range(4):
+                m = int(rng.integers(3, 12))
+                t = int(rng.integers(8, 40))
+                model = MODELS[int(rng.integers(0, len(MODELS)))]
+                net, meta, _ = build_priced(rng, m, t, model=model)
+                inst = extract_instance(net, meta)
+                insts.append(inst)
+                solo.append(solve_transport_dense(inst)[0])
+            br = solve_heterogeneous(insts)
+            for b, (inst, res) in enumerate(zip(insts, solo)):
+                T = inst.n_tasks
+                assert bool(br.converged[b]), (trial, b)
+                assert int(br.costs[b]) == res.cost, (trial, b)
+                assert np.array_equal(
+                    br.assignments[b, :T], res.assignment
+                ), (trial, b)
+
+    def test_empty_batch(self):
+        br = solve_heterogeneous([])
+        assert br.costs.shape == (0,)
+
+
+class TestServiceExactness:
+    """Service-level: a bucketed tenant round == its solo solve."""
+
+    @pytest.mark.parametrize("model", MODELS)
+    def test_cold_round_bit_identical_to_solo(self, model):
+        service = SchedulingService()
+        tenants = []
+        for i in range(3):
+            tid = f"t{i}"
+            # the tenant under test runs `model`; its bucket-mates run
+            # a DIFFERENT model each, so the batch is heterogeneous in
+            # cost model as well as shape
+            m = model if i == 0 else MODELS[(MODELS.index(model) + i)
+                                            % len(MODELS)]
+            service.add_tenant(tid, cost_model=m)
+            _feed(service, tid, _tenant_cluster(i))
+            tenants.append(tid)
+        results = _round_all(service, tenants)
+        for tid in tenants:
+            r = results[tid]
+            assert r.stats.backend == "dense_service"
+            solver = service.sessions[tid].solver
+            res, _ = solve_transport_dense(solver.last_instance)
+            assert res.converged
+            assert r.stats.cost == res.cost
+            assert np.array_equal(solver.last_assignment,
+                                  res.assignment)
+
+    @pytest.mark.parametrize("preemption", [False, True])
+    def test_bridge_differential_vs_solo_scheduler(self, preemption):
+        """A service tenant's whole ROUND (bindings + migrations +
+        preemptions + cost) equals a standalone scheduler's round over
+        the same observations — the bucket, the other tenants, and the
+        shared dispatcher change nothing."""
+        cluster = _tenant_cluster(
+            0, n_machines=6, n_tasks=24,
+            running_fraction=0.25 if preemption else 0.0, seed=77,
+        )
+        # solo: its own bridge + ResidentSolver (dense lane forced)
+        solo = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False,
+            enable_preemption=preemption,
+        )
+        solo.observe_nodes(cluster.machines)
+        solo.observe_pods(cluster.tasks)
+        solo_result = solo.run_scheduler()
+
+        service = SchedulingService()
+        service.add_tenant(
+            "t0", cost_model="quincy", enable_preemption=preemption
+        )
+        # a bucket-mate with a different shape and model
+        service.add_tenant("t1", cost_model="coco")
+        _feed(service, "t0", cluster)
+        _feed(service, "t1", _tenant_cluster(1, seed=78))
+        results = _round_all(service, ["t0", "t1"])
+        svc_result = results["t0"]
+        assert svc_result.bindings == solo_result.bindings
+        assert svc_result.migrations == solo_result.migrations
+        assert svc_result.preemptions == solo_result.preemptions
+        assert svc_result.stats.cost == solo_result.stats.cost
+
+    def test_warm_round_stays_optimal(self):
+        """Second (warm-context) waves certify and land on the same
+        optimum a cold solo solve finds."""
+        service = SchedulingService()
+        for i in range(2):
+            service.add_tenant(f"t{i}", cost_model="quincy")
+            _feed(service, f"t{i}", _tenant_cluster(i))
+        _round_all(service, ["t0", "t1"])
+        results = _round_all(service, ["t0", "t1"])  # warm wave
+        for tid in ("t0", "t1"):
+            r = results[tid]
+            assert r.stats.backend == "dense_service"
+            solver = service.sessions[tid].solver
+            res, _ = solve_transport_dense(solver.last_instance)
+            assert r.stats.cost == res.cost
+            assert np.array_equal(solver.last_assignment,
+                                  res.assignment)
+
+    def test_chunked_dispatch_still_exact(self):
+        """max_batch smaller than the wave splits a bucket into several
+        chunks (each one upload + one batched fetch) without changing
+        any tenant's answer."""
+        service = SchedulingService(max_batch=2)
+        tenants = []
+        for i in range(5):
+            tid = f"t{i}"
+            service.add_tenant(tid, cost_model="quincy")
+            _feed(service, tid, _tenant_cluster(i, seed=300 + i))
+            tenants.append(tid)
+        results = _round_all(service, tenants)
+        assert service.dispatcher.dispatches >= 2
+        for tid in tenants:
+            solver = service.sessions[tid].solver
+            res, _ = solve_transport_dense(solver.last_instance)
+            assert results[tid].stats.cost == res.cost
+            assert np.array_equal(solver.last_assignment,
+                                  res.assignment)
+
+
+def _churn(cluster, rng, round_no):
+    """Mutate a tenant's pod list in place: retire a couple of pending
+    pods, add a couple of new ones (<= 2 prefs each, so the pref-width
+    floor holds). Task counts oscillate but stay inside the warmed
+    padding bucket."""
+    tasks = [t for t in cluster.tasks if t.phase == TaskPhase.PENDING]
+    keep = tasks[2:] if len(tasks) > 10 else tasks
+    machines = cluster.machines
+    new = [
+        Task(
+            uid=f"{machines[0].name}-new-{round_no}-{k}",
+            job=f"job-new-{round_no}",
+            cpu_request=0.25,
+            memory_request_kb=1 << 18,
+            data_prefs={
+                machines[int(rng.integers(0, len(machines)))].name:
+                    int(rng.integers(20, 120))
+            },
+        )
+        for k in range(2)
+    ]
+    cluster.tasks[:] = keep + new
+    return cluster
+
+
+class TestZeroRecompile:
+    def test_steady_state_waves_compile_nothing(self):
+        """After a 2-wave warmup (cold + warm variants compile there),
+        >= 3 further waves of churning tenant shapes run with ZERO XLA
+        compiles: bucket dims, batch width, smax, and pricing pads all
+        ride grow-only floors."""
+        rng = np.random.default_rng(5)
+        service = SchedulingService()
+        clusters = {}
+        for i in range(3):
+            tid = f"t{i}"
+            service.add_tenant(tid, cost_model="quincy")
+            clusters[tid] = _tenant_cluster(i, seed=500 + i)
+            _feed(service, tid, clusters[tid])
+        tenants = list(clusters)
+        _round_all(service, tenants)   # wave 1: cold compiles
+        _round_all(service, tenants)   # wave 2: warm variant compiles
+        counter = CompileCounter()
+        with counter:
+            for w in range(3):
+                for tid in tenants:
+                    c = _churn(clusters[tid], rng, w)
+                    bridge = service.sessions[tid].bridge
+                    bridge.observe_nodes(c.machines)
+                    bridge.observe_pods(c.tasks)
+                results = _round_all(service, tenants)
+                for tid, r in results.items():
+                    assert r.stats.backend == "dense_service", (
+                        w, tid, r.stats.backend
+                    )
+        if not counter.supported:
+            pytest.skip("jax.monitoring unavailable")
+        assert counter.count == 0, (
+            f"{counter.count} steady-state recompiles in the service "
+            f"loop under churning tenant shapes"
+        )
+
+
+class TestIsolation:
+    def test_no_cross_tenant_uids_in_trace_or_decision_log(self):
+        service = SchedulingService()
+        clusters = {}
+        for i in range(3):
+            tid = f"t{i}"
+            service.add_tenant(tid, cost_model=MODELS[i])
+            clusters[tid] = _tenant_cluster(
+                i, seed=900 + i, prefix=f"{tid}-"
+            )
+            _feed(service, tid, clusters[tid])
+        tenants = list(clusters)
+        results = _round_all(service, tenants)
+        # confirm + re-round so RUNNING state and a second wave's
+        # events land in the streams too
+        for tid, r in results.items():
+            for uid, machine in r.bindings.items():
+                service.sessions[tid].bridge.confirm_binding(
+                    uid, machine
+                )
+        _round_all(service, tenants)
+        uids = {
+            tid: {t.uid for t in clusters[tid].tasks}
+            for tid in tenants
+        }
+        for tid in tenants:
+            session = service.sessions[tid]
+            own = uids[tid]
+            foreign = set().union(
+                *(uids[o] for o in tenants if o != tid)
+            )
+            for ev in session.trace.events:
+                if ev.task:
+                    assert ev.task in own, (tid, ev.task)
+                    assert ev.task not in foreign
+            for _round, _kind, uid, _detail in session.bridge.decision_log:
+                assert uid in own, (tid, uid)
+
+    def test_per_tenant_stats_isolated(self):
+        service = SchedulingService()
+        for i in range(2):
+            service.add_tenant(f"t{i}", cost_model="quincy")
+            _feed(service, f"t{i}", _tenant_cluster(i, seed=910 + i))
+        results = _round_all(service, ["t0", "t1"])
+        assert results["t0"].stats.round_num == 1
+        assert results["t1"].stats.round_num == 1
+        assert results["t0"].stats.lane == "service"
+        placed = {t: r.stats.pods_placed for t, r in results.items()}
+        # distinct clusters, distinct counts — nothing shared
+        assert placed["t0"] == len(results["t0"].bindings)
+        assert placed["t1"] == len(results["t1"].bindings)
+
+
+class TestBudgetMessage:
+    def test_batched_overflow_suggests_largest_fitting_batch(
+        self, monkeypatch
+    ):
+        monkeypatch.setattr(
+            dense_auction, "DENSE_TABLE_BUDGET_BYTES", 64 << 20
+        )
+        # one 2048x2048 table = 16 MiB -> 4 fit, 8 do not
+        with pytest.raises(DenseMemoryTooLarge) as ei:
+            check_table_budget(2048, 2048, 8)
+        msg = str(ei.value)
+        assert "n_variants <= 4" in msg
+        assert "--serve_max_batch" in msg
+        assert max_variants_for(2048, 2048) == 4
+
+    def test_single_instance_overflow_keeps_mesh_hint(
+        self, monkeypatch
+    ):
+        monkeypatch.setattr(
+            dense_auction, "DENSE_TABLE_BUDGET_BYTES", 1 << 20
+        )
+        with pytest.raises(DenseMemoryTooLarge) as ei:
+            check_table_budget(2048, 2048, 1)
+        msg = str(ei.value)
+        assert "n_variants" not in msg     # unbatched shape: no hint
+        assert "--mesh_width" in msg
+
+    def test_dispatcher_chunks_against_budget(self, monkeypatch):
+        """A wave wider than the budget's fit splits into fitting
+        chunks instead of raising (and every tenant still solves)."""
+        # ~1.07 MiB budget: each (32, 16) member costs ~few KiB, but
+        # shrink until only 2 fit to force the split deterministically
+        from poseidon_tpu.service import dispatch as dispatch_mod
+
+        real_fit = dispatch_mod.max_variants_for
+
+        def tiny_fit(Tp, Mp, side_ints_per_variant=0, **kw):
+            return min(real_fit(
+                Tp, Mp, side_ints_per_variant=side_ints_per_variant,
+                **kw,
+            ), 2)
+
+        monkeypatch.setattr(dispatch_mod, "max_variants_for", tiny_fit)
+        service = SchedulingService()
+        tenants = []
+        for i in range(5):
+            tid = f"t{i}"
+            service.add_tenant(tid, cost_model="quincy")
+            _feed(service, tid, _tenant_cluster(i, seed=700 + i))
+            tenants.append(tid)
+        results = _round_all(service, tenants)
+        assert service.dispatcher.dispatches >= 3
+        for tid in tenants:
+            solver = service.sessions[tid].solver
+            res, _ = solve_transport_dense(solver.last_instance)
+            assert results[tid].stats.cost == res.cost
+
+
+class TestFrontDoor:
+    def test_tenant_resubmitted_while_in_flight_waits_a_wave(self):
+        service = SchedulingService()
+        service.add_tenant("t0", cost_model="quincy")
+        _feed(service, "t0", _tenant_cluster(0, seed=40))
+        f1 = service.submit("t0")
+        service.pump()             # wave 1 in flight
+        f2 = service.submit("t0")  # must NOT join the in-flight wave
+        service.pump()             # finishes wave 1, starts wave 2
+        assert f1.done()
+        service.flush()
+        assert f2.done()
+        assert f1.result().stats.round_num == 1
+        assert f2.result().stats.round_num == 2
+
+    def test_unknown_tenant_raises(self):
+        service = SchedulingService()
+        with pytest.raises(KeyError):
+            service.submit("nope")
+
+    def test_empty_round_resolves_synchronously(self):
+        service = SchedulingService()
+        service.add_tenant("t0", cost_model="quincy")
+        # machines but no pods: nothing schedulable
+        cluster = _tenant_cluster(0, n_tasks=0, seed=41)
+        _feed(service, "t0", cluster)
+        fut = service.submit("t0")
+        service.pump()
+        assert fut.done()
+        assert fut.result().bindings == {}
+
+    def test_non_taxonomy_or_oracle_degrade_is_loud(self):
+        """An uncertifiable tenant degrades alone (backend oracle:*),
+        without touching its bucket-mates."""
+        service = SchedulingService()
+        service.add_tenant("t0", cost_model="quincy")
+        _feed(service, "t0", _tenant_cluster(0, seed=42))
+        # poison the budget so t0's registration degrades to oracle
+        import poseidon_tpu.service.dispatch as dispatch_mod
+
+        def no_fit(*a, **kw):
+            raise DenseMemoryTooLarge("forced by test")
+
+        orig = dispatch_mod.check_table_budget
+        dispatch_mod.check_table_budget = no_fit
+        try:
+            results = _round_all(service, ["t0"])
+        finally:
+            dispatch_mod.check_table_budget = orig
+        assert results["t0"].stats.backend == "oracle:memory-envelope"
+        # degraded rounds still place exactly (the oracle is exact)
+        assert results["t0"].stats.pods_placed > 0
+
+
+class TestServeDriver:
+    def test_serve_e2e_three_fake_tenants(self):
+        """The --serve loop end to end: 3 heterogeneous fake-apiserver
+        tenants, every pod bound on ITS OWN apiserver, no cross-tenant
+        binding leakage."""
+        import contextlib
+
+        from poseidon_tpu.cli import main
+        from poseidon_tpu.service import serve as serve_mod
+
+        captured = {}
+        real = serve_mod._fake_tenants
+
+        def capture(n, stack):
+            out = real(n, stack)
+            captured["tenants"] = [
+                (tid, server) for tid, server, _m, _p in out
+            ]
+            return out
+
+        with contextlib.ExitStack() as stack:
+            serve_mod._fake_tenants = capture
+            stack.callback(
+                lambda: setattr(serve_mod, "_fake_tenants", real)
+            )
+            rc = main([
+                "--serve=true",
+                "--serve_tenants=3",
+                "--polling_frequency=100000",
+                "--max_rounds=8",
+            ])
+        assert rc == 0
+        assert len(captured["tenants"]) == 3
+        for tid, server in captured["tenants"]:
+            i = tid.split("-")[1]
+            assert len(server.bindings) == len(server.pods), tid
+            for key, node in server.bindings:
+                # tenant i's pods bind only to tenant i's nodes
+                assert key.startswith(f"default/t{i}-pod-"), key
+                assert node.startswith(f"t{i}-n"), (key, node)

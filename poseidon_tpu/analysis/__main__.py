@@ -1,8 +1,30 @@
-"""CLI entry: ``python -m poseidon_tpu.analysis [--format=...] [paths]``.
+"""CLI entry: ``python -m poseidon_tpu.analysis [options] [paths]``.
 
 Exit codes: 0 clean, 1 violations found, 2 usage error. CI runs
-``python -m poseidon_tpu.analysis --format=json`` as a blocking step
-(after ruff, before the test suite).
+``python -m poseidon_tpu.analysis --format=json --audit-suppressions``
+as a blocking step (after ruff, before the test suite) and the jaxpr
+kernel audit (``--jaxpr``) on both the plain and 8-virtual-device
+lanes.
+
+Passes:
+
+- the AST rules (always): PTA001-PTA005 file/repo rules plus the
+  whole-program passes — PTA006 (lockset race detection over the
+  thread model) and PTA007 (recompile-hazard static-arg provenance);
+- ``--audit-suppressions``: additionally report DEAD ``# noqa:
+  PTA0xx`` comments (rule no longer fires on that statement);
+- ``--jaxpr``: additionally trace the production kernels and audit
+  their closed jaxprs against ``analysis/kernel_fingerprints.json``
+  (PTA008). ``--jaxpr-only`` runs just that audit (the CI audit step
+  — its lint step already ran the AST rules). ``--update-fingerprints``
+  re-pins the file instead of diffing (structural contract problems
+  still report).
+
+The JSON document's schema is load-bearing for CI and downstream
+tooling and is locked by tests/test_analysis.py::TestJsonSchema:
+``violations`` (objects with exactly code/rule/path/line/col/message,
+sorted by (path, line, col, code)), ``count``, ``files_scanned``, and
+— only when ``--jaxpr`` ran — ``kernels_audited``.
 """
 
 from __future__ import annotations
@@ -12,6 +34,7 @@ import pathlib
 import sys
 
 from poseidon_tpu.analysis.core import (
+    analyze_and_audit,
     analyze_tree,
     format_human,
     format_json,
@@ -23,14 +46,17 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m poseidon_tpu.analysis",
         description=(
             "Contract linter: enforce the repo's hot-path, O(churn), "
-            "jit-hygiene, thread-discipline, and surface-consistency "
-            "invariants (rules PTA001-PTA005; see analysis/rules.py)"
+            "jit-hygiene, thread-discipline, surface-consistency, "
+            "lockset-race and recompile-hazard invariants (rules "
+            "PTA001-PTA007; see analysis/rules.py, analysis/"
+            "threads.py, analysis/recompile.py), plus the compiled-"
+            "kernel jaxpr audit (PTA008, analysis/jaxpr_check.py)"
         ),
     )
     p.add_argument(
         "paths", nargs="*",
         help="files to scan (default: the shipped tree — "
-             "poseidon_tpu/, scripts/, bench.py)",
+             "poseidon_tpu/, scripts/, tests/, bench.py)",
     )
     p.add_argument(
         "--format", choices=("human", "json"), default="human",
@@ -39,6 +65,26 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "--root", default=".",
         help="repo root (scopes and doc files resolve against it)",
+    )
+    p.add_argument(
+        "--audit-suppressions", action="store_true",
+        help="also report dead '# noqa: PTA0xx' suppressions "
+             "(reasoned noqas whose rule no longer fires there)",
+    )
+    p.add_argument(
+        "--jaxpr", action="store_true",
+        help="also trace the production kernels and audit their "
+             "closed jaxprs (callbacks/transfers/f64/fingerprints)",
+    )
+    p.add_argument(
+        "--jaxpr-only", action="store_true",
+        help="run ONLY the kernel jaxpr audit, skipping the AST rules "
+             "(the CI audit step: the lint step already ran them)",
+    )
+    p.add_argument(
+        "--update-fingerprints", action="store_true",
+        help="re-trace the kernels and rewrite analysis/"
+             "kernel_fingerprints.json (implies --jaxpr)",
     )
     args = p.parse_args(argv)
 
@@ -61,9 +107,35 @@ def main(argv: list[str] | None = None) -> int:
                 paths.extend(sorted(path.rglob("*.py")))
             else:
                 paths.append(path)
-    violations, files_scanned = analyze_tree(root, paths)
-    formatter = format_json if args.format == "json" else format_human
-    print(formatter(violations, files_scanned))
+    if args.jaxpr_only:
+        violations, files_scanned = [], 0
+    else:
+        run = (
+            analyze_and_audit if args.audit_suppressions
+            else analyze_tree
+        )
+        violations, files_scanned = run(root, paths)
+    kernels_audited = None
+    if args.jaxpr or args.jaxpr_only or args.update_fingerprints:
+        from poseidon_tpu.analysis.jaxpr_check import run_jaxpr_audit
+
+        jaxpr_violations, kernels_audited = run_jaxpr_audit(
+            root, update=args.update_fingerprints
+        )
+        # the merged document keeps the locked (path, line, col, code)
+        # ordering whichever passes contributed
+        violations = sorted(
+            violations + jaxpr_violations,
+            key=lambda v: (v.path, v.line, v.col, v.code),
+        )
+
+    if args.format == "json":
+        print(format_json(violations, files_scanned, kernels_audited))
+    else:
+        out = format_human(violations, files_scanned)
+        if kernels_audited is not None:
+            out += f"\n{kernels_audited} kernel jaxpr(s) audited"
+        print(out)
     return 1 if violations else 0
 
 

@@ -90,10 +90,39 @@ class Contracts:
     # collections (iterating one of these in an O(churn) scope flags)
     cluster_sized_names: tuple[str, ...] = ()
 
-    # ---- PTA004: thread discipline ------------------------------------
+    # ---- PTA004 + PTA006: thread discipline ---------------------------
     thread_classes: dict[str, ThreadContract] = dataclasses.field(
         default_factory=dict
     )
+    # PTA006 spawn inference: callables that run a callable argument on
+    # a background thread (the repo's thread-launching wrappers). A
+    # lambda / function reference passed to one of these is a thread
+    # root: its body executes concurrently with the caller.
+    thread_spawn_wrappers: tuple[str, ...] = ()
+
+    # ---- PTA007: recompile-hazard dataflow ----------------------------
+    # attribute reads that are data-dependent quantities (live-state
+    # maxima, per-round counts): deriving a static arg or pad floor
+    # from one of these without riding a grow-only floor is the
+    # recompile bug class PR 8 had to flush out at runtime
+    hazard_attrs: tuple[str, ...] = ()
+    # name fragments that mark a value as riding a grow-only floor
+    # (matching is substring for "floor", exact for the pad-parameter
+    # vocabulary): an expression referencing one of these is sanctified
+    floor_markers: tuple[str, ...] = ()
+    # host padding helpers whose listed keyword args are SHAPE floors:
+    # a tainted, un-floored value flowing into one of these recompiles
+    # the fused chain exactly like a tainted static arg
+    pad_sinks: dict[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    # ---- per-path rule scoping ----------------------------------------
+    # (path prefix, codes enforced there); first match wins, files
+    # matching no entry get every rule. tests/ runs the jit-hygiene +
+    # vocabulary rules only (test files deliberately contain seeded
+    # violations for the rest, as data)
+    path_rules: tuple[tuple[str, tuple[str, ...]], ...] = ()
 
     # ---- PTA005: trace vocabulary + flag surface ----------------------
     trace_module: str = "poseidon_tpu/trace.py"
@@ -209,6 +238,12 @@ DEFAULT_CONTRACTS = Contracts(
             "ResidentSolver.begin_round",
             "ResidentSolver.finish_round",
             "ResidentSolver.express_round",
+            # the express context's lazy host-map build: its two
+            # deliberate O(T) walks carry reasoned suppressions (the
+            # suppression audit proved the previous scope omission
+            # made those noqas dead — any NEW cluster walk here now
+            # actually fails CI)
+            "ResidentSolver._express_finalize",
         ),
         # the service dispatch/pipeline scopes run once per WAVE across
         # N tenants: an O(tenants x cluster) host walk there turns the
@@ -281,25 +316,21 @@ DEFAULT_CONTRACTS = Contracts(
         "HealthState": ThreadContract(lock_attr="_lock", handoffs={}),
         # the endpoint server: started/stopped from the driver thread
         # only; the serving thread touches the httpd object, never
-        # ObsServer attributes
-        "ObsServer": ThreadContract(
-            lock_attr="_lock",
-            handoffs={
-                "_httpd": "created before Thread.start() and only "
-                          "mutated by start()/stop() on the driver "
-                          "thread; Thread.start() is the happens-"
-                          "before edge for the serving thread",
-            },
-        ),
-        # watch.py's per-resource reader thread
+        # ObsServer attributes (the former ``_httpd`` handoff entry was
+        # PTA006-audited stale: no background context reads the
+        # attribute — the serving thread holds the httpd OBJECT via
+        # Thread(target=), it never dereferences ``self._httpd``)
+        "ObsServer": ThreadContract(lock_attr="_lock", handoffs={}),
+        # watch.py's per-resource reader thread (the former ``rv``
+        # handoff entry was PTA006-audited stale: the reconnect cursor
+        # is reader-thread-private — construction aside, no main-thread
+        # access exists, so there is no handoff to document)
         "_WatchStream": ThreadContract(
             lock_attr="_lock",
             handoffs={
                 "_resp": "benign race with stop(): closing a stale "
                          "response object at worst forces one counted "
                          "reconnect; queue.Queue carries the real data",
-                "rv": "reader-thread-private reconnect cursor; main "
-                      "thread never reads it",
                 "seen_rv": "monotonic int advanced only after the event "
                            "is enqueued; torn reads impossible on a GIL "
                            "int, staleness means one extra wait loop",
@@ -309,4 +340,31 @@ DEFAULT_CONTRACTS = Contracts(
             },
         ),
     },
+    thread_spawn_wrappers=(
+        # ops/resident.py's single-shot background download: the fn
+        # passed to its constructor runs on the fetch daemon thread
+        "_AsyncFetch",
+    ),
+    hazard_attrs=(
+        # data-dependent shape/width sources: topology maxima and
+        # builder counts change with live cluster state every round
+        "max_prefs",
+        "n_arcs",
+        "n_tasks",
+        "n_machines",
+    ),
+    floor_markers=(
+        "floor",        # substring: _s_floor, ctx.p_floor, _b_floor...
+        "t_min",
+        "m_min",
+        "p_min",
+        "minimum",
+    ),
+    pad_sinks={
+        "pad_topology": ("t_min", "m_min", "p_min"),
+        "build_cost_inputs_host": ("t_min", "m_min"),
+    },
+    path_rules=(
+        ("tests/", ("PTA000", "PTA003", "PTA005")),
+    ),
 )

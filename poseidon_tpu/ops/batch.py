@@ -413,7 +413,7 @@ def solve_heterogeneous(
     zeros_t = jnp.zeros(Tp, I32)
     zeros_m = jnp.zeros(Mp, I32)
     with enable_x64(True):
-        outs = [
+        outs = [  # noqa: PTA007 -- one-shot convenience lane: solve_heterogeneous compiles per shape mix by design; the warm/floored path is BatchDispatcher (service/dispatch.py)
             _solve_member(
                 *(stacked[k] for k in MEMBER_KEYS), jnp.int32(b),
                 zeros_t, zeros_t, zeros_m,

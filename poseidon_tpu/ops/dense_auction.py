@@ -377,7 +377,7 @@ def build_dense_instance(inst: TransportInstance) -> DenseInstance:
     Mp = pad_bucket(max(M, 1))
     check_table_budget(Tp, Mp)
     t = build_member_tables(inst, Tp, Mp, P)
-    c = _densify(
+    c = _densify(  # noqa: PTA007 -- one-shot solo lane: build_dense_instance compiles per instance shape by design; warm rounds ride ResidentSolver's grow-only floors
         jnp.asarray(t["w"]), jnp.asarray(t["d"]), jnp.asarray(t["ra"]),
         jnp.asarray(t["rack_of"]), jnp.asarray(t["slots"]),
         jnp.asarray(t["pc"]), jnp.asarray(t["pm"]),

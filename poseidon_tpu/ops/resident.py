@@ -1555,7 +1555,11 @@ class ResidentSolver:
             ctx.pending_freeze = (
                 run_rows.astype(np.int32), cols,
             )
-            for i in run_rows.tolist():  # noqa: PTA002 -- one-time lazy freeze of the running block per round, amortized over the inter-round window
+            # one-time lazy freeze of the running block per round,
+            # amortized over the inter-round window (PTA002 cannot
+            # see this loop — run_rows is not a declared cluster-sized
+            # name — so the former noqa here was audited dead)
+            for i in run_rows.tolist():
                 u = ctx.row_uid.pop(i, None)
                 if u is not None:
                     ctx.uid_row.pop(u, None)

@@ -81,11 +81,11 @@ class TestCompileCounter:
         with CompileCounter() as cc:
             if not cc.supported:
                 pytest.skip("jax.monitoring unavailable")
-            jax.jit(lambda x: x * 3 + 1)(jnp.arange(7))
+            jax.jit(lambda x: x * 3 + 1)(jnp.arange(7))  # noqa: PTA003 -- the fresh wrapper IS the fixture: this test counts backend compiles of brand-new computations
         first = cc.count
         assert first >= 1
         with CompileCounter() as cc2:
-            jax.jit(lambda x: x * 3 + 1)(jnp.arange(7))
+            jax.jit(lambda x: x * 3 + 1)(jnp.arange(7))  # noqa: PTA003 -- deliberate second fresh wrapper: proves re-tracing an identical computation does not re-COMPILE
         # the lambda re-traces (new function object) but the counter
         # only grows for actual backend compiles of NEW computations
         assert cc2.count <= first

@@ -1,0 +1,151 @@
+"""Tests for the compiled-program auditor (analysis/jaxpr_check.py).
+
+The production kernels are traced ONCE per module (the expensive part:
+one tiny bootstrap round plus five make_jaxpr traces) and every audit
+path — structural contracts, fingerprint pinning, the smuggled-
+constant / debug-print / f64 detectors — is driven from that set.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu.analysis import jaxpr_check as jc
+from poseidon_tpu.compat import enable_x64
+from poseidon_tpu.ops.dense_auction import DenseInstance, _solve
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+EXPECTED_KERNELS = {
+    "solve", "resident_chain", "express_patch", "express_chain",
+    "solve_member",
+}
+
+
+@pytest.fixture(scope="module")
+def traces():
+    return jc.trace_production_kernels()
+
+
+class TestProductionAudit:
+    def test_all_production_kernels_traced(self, traces):
+        assert set(traces) == EXPECTED_KERNELS
+        for t in traces.values():
+            assert sum(jc.primitive_counts(t).values()) > 0
+
+    def test_structural_contracts_hold(self, traces):
+        for name, t in traces.items():
+            assert jc.structural_problems(name, t) == []
+
+    def test_fingerprints_match_committed(self, traces):
+        """The committed kernel_fingerprints.json matches HEAD's traces
+        — the CI gate, exercised through the real audit entry."""
+        violations, audited = jc.run_jaxpr_audit(REPO, traces=traces)
+        assert audited == len(EXPECTED_KERNELS)
+        assert violations == [], "\n".join(
+            v.message for v in violations
+        )
+
+    def test_kernels_are_transfer_and_callback_free(self, traces):
+        for name, t in traces.items():
+            prims = jc.primitive_counts(t)
+            assert "device_put" not in prims, name
+            assert not any("callback" in p for p in prims), name
+
+    def test_update_then_audit_roundtrip(self, traces, tmp_path):
+        fp = tmp_path / jc.FINGERPRINT_FILE
+        fp.parent.mkdir(parents=True)
+        vs, _ = jc.run_jaxpr_audit(tmp_path, update=True, traces=traces)
+        assert vs == []
+        assert json.loads(fp.read_text())["kernels"].keys() == \
+            EXPECTED_KERNELS
+        vs, _ = jc.run_jaxpr_audit(tmp_path, traces=traces)
+        assert vs == []
+
+    def test_missing_fingerprint_file_reported(self, traces, tmp_path):
+        vs, _ = jc.run_jaxpr_audit(tmp_path, traces=traces)
+        assert len(vs) == 1
+        assert "missing" in vs[0].message
+        assert vs[0].code == "PTA008"
+
+
+def _tiny_instance(Tp=16, Mp=16):
+    return DenseInstance(
+        c=np.full((Tp, Mp), 3, np.int32),
+        u=np.full(Tp, 9, np.int32),
+        w=np.full(Tp, 2, np.int32),
+        dgen=np.ones(Mp, np.int32),
+        s=np.ones(Mp, np.int32),
+        task_valid=np.ones(Tp, bool),
+        scale=np.int32(Tp + 1),
+        cmax=np.int32(64),
+        smax=4,
+    )
+
+
+class TestDetectors:
+    """The acceptance injections: a smuggled host constant inside
+    _solve, a stray debug print, and an f64 leak are each caught."""
+
+    def test_smuggled_host_constant_in_solve_caught(self, traces):
+        """A ``jnp.asarray(host_val)`` smuggled into the solve chain
+        becomes a closure constant: flagged structurally AND as a
+        fingerprint diff against the pinned solve."""
+        dev = _tiny_instance()
+        Tp = dev.c.shape[0]
+        host_val = np.arange(4096, dtype=np.int32)  # module-ish state
+
+        def smuggled(dev, a, lv, f, e):
+            out = _solve(
+                dev, a, lv, f, e, alpha=16, max_rounds=8, smax=4,
+                analytic_init=False,
+            )
+            return out[0] + jnp.asarray(host_val)[:Tp]
+
+        zeros_t = np.zeros(Tp, np.int32)
+        zeros_m = np.zeros(dev.c.shape[1], np.int32)
+        with enable_x64(True):
+            closed = jax.make_jaxpr(smuggled)(
+                dev, zeros_t, zeros_t, zeros_m, np.int32(1)
+            )
+        probs = jc.structural_problems("solve", closed)
+        assert any("smuggled host array" in p for p in probs), probs
+        # the fingerprint lane catches it too (const census changed)
+        want = json.loads(
+            (REPO / jc.FINGERPRINT_FILE).read_text()
+        )["kernels"]["solve"]
+        diff = jc.diff_fingerprint(
+            "solve", jc.fingerprint(closed), want
+        )
+        assert any("const" in d for d in diff), diff
+
+    def test_debug_print_caught(self):
+        def chatty(x):
+            jax.debug.print("x={x}", x=x)
+            return x * 2
+
+        closed = jax.make_jaxpr(chatty)(np.arange(8, dtype=np.int32))
+        probs = jc.structural_problems("chatty", closed)
+        assert any("banned primitive" in p for p in probs), probs
+
+    def test_f64_leak_caught(self):
+        with enable_x64(True):
+            closed = jax.make_jaxpr(
+                lambda x: jnp.asarray(x, jnp.float64) * 1.5
+            )(np.arange(8, dtype=np.int32))
+        probs = jc.structural_problems("leaky", closed)
+        assert any("float64" in p for p in probs), probs
+
+    def test_fingerprint_diff_reports_primitive_change(self, traces):
+        got = jc.fingerprint(traces["solve"])
+        want = json.loads(json.dumps(got))  # deep copy
+        want["primitives"]["while"] = \
+            want["primitives"].get("while", 0) + 1
+        diff = jc.diff_fingerprint("solve", got, want)
+        assert any("'while'" in d for d in diff), diff

@@ -165,7 +165,7 @@ class TestEndToEnd:
             (B, inputs.machine_load.shape[0]))
 
         @jax.jit
-        def batch_costs(load):
+        def batch_costs(load):  # noqa: PTA003 -- test-local one-shot jit: the closure over `inputs` is the vmap-what-if fixture under test, traced exactly once
             import dataclasses as dc
             return jax.vmap(
                 lambda ld: octopus_cost(dc.replace(inputs, machine_load=ld))

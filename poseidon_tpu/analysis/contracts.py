@@ -39,6 +39,19 @@ class ThreadContract:
 # one — one constant referenced from both maps so the two enforcement
 # surfaces cannot drift apart
 _OBS_HOT_SCOPES = {
+    "poseidon_tpu/obs/flightrec.py": (
+        # the flight recorder's capture helpers run inside the round's
+        # begin/finish window and the express fast path: vectorized
+        # np copies of already-host arrays only — never a device sync,
+        # never an O(cluster) Python walk (the dump WRITER is not
+        # listed: it runs on the anomaly/on-demand path, off the
+        # round's critical path by design)
+        "FlightRecorder.capture_begin",
+        "FlightRecorder.capture_finish",
+        "FlightRecorder.capture_express",
+        "FlightRecorder._trim",
+        "_copy_meta",
+    ),
     "poseidon_tpu/obs/metrics.py": (
         "Counter.inc",
         "Gauge.set",

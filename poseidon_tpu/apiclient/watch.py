@@ -393,6 +393,18 @@ class ClusterWatcher:
         self._seeded = True
         return nodes, pods
 
+    @property
+    def applied_rv(self) -> str:
+        """The per-resource resourceVersions the bridge has APPLIED up
+        to, as one ``nodes=N,pods=M`` string — the stream-position
+        stamp the flight recorder records with each round so a dump
+        correlates with the apiserver's watch history."""
+        return ",".join(
+            f"{r}={self._applied_rv[r]}" for r in sorted(
+                self._applied_rv
+            )
+        )
+
     # ---- the per-tick pump ----
 
     def tick(self) -> ObserveDelta:

@@ -117,6 +117,7 @@ from poseidon_tpu.ops.resident import (
     InflightSolve,
     ResidentSolver,
 )
+from poseidon_tpu.obs.metrics import STORM_RESYNCS, STORM_WINDOW
 from poseidon_tpu.obs.spans import (
     emit_span,
     express_span_tree,
@@ -259,6 +260,9 @@ class InflightRound:
     t_begin_start: float = 0.0
     t_begin_end: float = 0.0
     begin_ms: float = 0.0
+    # the flight recorder's begin-time record of this round's inputs
+    # (obs/flightrec.py); finish_round attaches the outcome to it
+    flight: object = None
 
 
 class SchedulerBridge:
@@ -285,6 +289,7 @@ class SchedulerBridge:
         metrics=None,
         profile_spans: bool = False,
         solver=None,
+        flightrec=None,
     ):
         self.cost_model = cost_model
         self.max_tasks_per_machine = max_tasks_per_machine
@@ -303,6 +308,16 @@ class SchedulerBridge:
         # round's stats for the metrics/report grouping.
         self.metrics = metrics
         self.profile_spans = profile_spans
+        # the anomaly flight recorder (obs/flightrec.py, --flight_
+        # recorder): captures each round's full host-side inputs at
+        # begin time and dumps the ring on DEGRADE / EXPRESS_DEGRADE /
+        # FETCH_TIMEOUT / resync-storm or on demand. None = off, zero
+        # cost.
+        self.flightrec = flightrec
+        # the watch stream position recorded with each round's flight
+        # record (driver-set: cli stamps ClusterWatcher.applied_rv per
+        # tick; "" = poll mode / no driver stamp)
+        self.flight_rv = ""
         self.lane = ""
         self.knowledge = KnowledgeBase(queue_size=sample_queue_size)
         self.machines: dict[str, Machine] = {}
@@ -358,6 +373,14 @@ class SchedulerBridge:
         # consecutive implausible-shrink polls (mass-eviction guard)
         self._node_shrink_strikes = 0
         self._pod_shrink_strikes = 0
+        # resync-storm trip for the flight recorder: a sliding window
+        # of per-round resync counts (the obs/metrics.py storm gauge's
+        # twin), latched so a persisting storm dumps once, not every
+        # round
+        self._resync_window: collections.deque[int] = collections.deque(
+            maxlen=STORM_WINDOW
+        )
+        self._storm_dumped = False
         self._inflight: InflightRound | None = None
         # ---- express-lane bookkeeping (all empty with the flag off) ----
         # bound pods whose on-HBM rows the next express dispatch
@@ -694,6 +717,26 @@ class SchedulerBridge:
         self._watch_resyncs += resyncs
         self._watch_reconnects += reconnects
 
+    def flight_dump(
+        self, reason: str = "manual", label: str = ""
+    ) -> str | None:
+        """Dump the flight-recorder ring (anomaly sites call this with
+        their bounded reason; operators/drivers call it on demand).
+        Returns the manifest path, or None when the recorder is off or
+        the ring is empty. Every dump is loud: a FLIGHTREC_DUMP trace
+        event plus ``poseidon_flightrec_dumps_total{reason}``."""
+        if self.flightrec is None:
+            return None
+        path = self.flightrec.dump(reason, label=label)
+        if path is not None:
+            self.trace.emit(
+                "FLIGHTREC_DUMP", round_num=self.round_num,
+                detail={"reason": reason, "label": label,
+                        "path": path},
+            )
+            self.trace.flush()
+        return path
+
     # ---- the express lane (between-ticks fast path) --------------------
 
     def _express_invalidate(self, count_degrade: bool = False,
@@ -709,6 +752,7 @@ class SchedulerBridge:
                 self.trace.flush()
                 if self.metrics is not None:
                     self.metrics.record_express_degrade(why)
+                self.flight_dump("express-degrade", label=why)
 
     def _express_transitions(
         self, before: dict[str, Task | None]
@@ -859,6 +903,15 @@ class SchedulerBridge:
             self.trace.flush()
             if self.metrics is not None:
                 self.metrics.record_express_degrade(outcome.reason)
+            if self.flightrec is not None:
+                # record the degraded batch's inputs, then dump: "what
+                # exactly did the express lane choke on" survives
+                self.flightrec.capture_express(
+                    self.round_num, batch, outcome
+                )
+                self.flight_dump(
+                    "express-degrade", label=outcome.reason
+                )
             return None
         self._express_batches += 1
         bindings: dict[str, str] = {}
@@ -888,9 +941,10 @@ class SchedulerBridge:
             bindings[uid] = machine
             self._express_placed[uid] = machine
             self._express_unconfirmed.add(uid)
-            self.decision_log.append(
-                (self.round_num, "PLACE", uid, machine)
-            )
+            self.decision_log.append((
+                self.round_num, "PLACE", uid,
+                {"machine": machine, "express": True},
+            ))
             e2b = (
                 (t_done - uid_t[uid]) * 1000 if uid in uid_t
                 else latency
@@ -915,6 +969,10 @@ class SchedulerBridge:
         self.trace.flush()
         if self.metrics is not None:
             self.metrics.record_express_batch(e2b_samples)
+        if self.flightrec is not None:
+            self.flightrec.capture_express(
+                self.round_num, batch, outcome, placements=bindings
+            )
         return ExpressResult(
             bindings=bindings,
             cost=outcome.cost,
@@ -1012,6 +1070,21 @@ class SchedulerBridge:
         self._watch_resyncs = 0
         stats.watch_reconnects = self._watch_reconnects
         self._watch_reconnects = 0
+        if self.flightrec is not None:
+            # resync-storm trip (the obs storm gauge's recorder twin):
+            # a flapping watch stream re-listing the cluster every tick
+            # is exactly the incident whose inputs should survive
+            self._resync_window.append(stats.watch_resyncs)
+            if sum(self._resync_window) >= STORM_RESYNCS:
+                if not self._storm_dumped:
+                    self._storm_dumped = True
+                    self.flight_dump(
+                        "resync-storm",
+                        label=f"{sum(self._resync_window)} resyncs "
+                              f"in the last {STORM_WINDOW} rounds",
+                    )
+            else:
+                self._storm_dumped = False
         stats.express_batches = self._express_batches
         self._express_batches = 0
         stats.express_places = self._express_places
@@ -1126,6 +1199,53 @@ class SchedulerBridge:
             t_begin_end=t_end,
             begin_ms=(t_end - t_start) * 1000,
         )
+        if self.flightrec is not None:
+            # capture AFTER the dispatch: the arrays are exactly what
+            # the solve consumed, the solver's padding floors/dims are
+            # this round's, and the warm seed (when clean) is the host
+            # mirror the LAST round's fetch already downloaded — no
+            # device sync, vectorized copies only (PTA001/PTA002
+            # registered scopes in obs/flightrec.py)
+            ir.flight = self.flightrec.capture_begin(
+                round_num=self.round_num,
+                cost_model=self.cost_model,
+                flags={
+                    "enable_preemption": self.enable_preemption,
+                    "migration_hysteresis": self.migration_hysteresis,
+                    "max_migrations_per_round":
+                        self.max_migrations_per_round,
+                    "express_lane": self.express_lane,
+                    "express_max_batch": getattr(
+                        self.solver, "express_max_batch", 16
+                    ),
+                    "small_to_oracle": getattr(
+                        self.solver, "small_to_oracle", True
+                    ),
+                    "mesh_width": getattr(self.solver, "mesh_width", 0),
+                    "aggregate_classes": getattr(
+                        self.solver, "aggregate_classes", False
+                    ),
+                    "topk_prefs": getattr(self.solver, "topk_prefs", 0),
+                    "lane": self.lane,
+                    "build_mode": stats.build_mode,
+                },
+                arrays=arrays,
+                meta=meta,
+                cost_kwargs=cost_kwargs,
+                pad_floors=getattr(self.solver, "pad_floors", {}),
+                dims={
+                    "Tp": getattr(solve, "Tp", 0),
+                    "Mp": getattr(solve, "Mp", 0),
+                    "n_prefs": getattr(solve, "n_prefs", 0),
+                    "smax": getattr(solve, "smax", 0),
+                },
+                warm_used=getattr(solve, "warm_used", False),
+                warm_seed=(
+                    getattr(self.solver, "warm_seed_host", None)
+                    if getattr(solve, "warm_used", False) else None
+                ),
+                rv=self.flight_rv,
+            )
         self._inflight = ir
         return ir
 
@@ -1150,13 +1270,17 @@ class SchedulerBridge:
             # the pipelined fetch missed its --max_solver_runtime
             # deadline: degrade LOUDLY (trace event + counter surfaced
             # in the NEXT round's stats, since this one is abandoned)
-            # and let the driver's round-failure path skip the tick
+            # and let the driver's round-failure path skip the tick.
+            # The flight recorder dumps the abandoned round's inputs —
+            # "what was the round doing at the timeout" is exactly the
+            # post-mortem question.
             self._fetch_timeouts += 1
             self.trace.emit(
                 "FETCH_TIMEOUT", round_num=ir.stats.round_num,
                 detail={"error": str(e)},
             )
             self.trace.flush()
+            self.flight_dump("fetch-timeout", label=str(e))
             raise
         t_join1 = time.monotonic()
         meta = ir.meta
@@ -1184,6 +1308,7 @@ class SchedulerBridge:
         # logged: a DEGRADE trace event + the lifetime counter in
         # stats. Deliberate routing (small-instance, non-taxonomy
         # graphs) is dispatch, not degradation, and stays uncounted.
+        flight_dump_why = ""
         if outcome.backend.startswith("oracle:"):
             why = outcome.backend.split(":", 1)[1]
             if why not in ("small-instance", "not-scheduling-shaped"):
@@ -1194,19 +1319,27 @@ class SchedulerBridge:
                 )
                 if self.metrics is not None:
                     self.metrics.record_degrade(why)
+                # dumped AFTER the outcome is attached to the record
+                # below, so the dump carries this round's result too
+                flight_dump_why = why
         stats.degrades_total = self._degrades_total
 
         # the decision layer: diff the solved assignment against current
         # placements into typed PLACE | MIGRATE | PREEMPT | NOOP records
         # (graph/deltas.py), budget-bounded in rebalancing mode. In
         # place-only mode every task is pending, so this reduces to the
-        # old place-or-age classification exactly.
+        # old place-or-age classification exactly. Each delta carries
+        # its exact route cost + runner-up margin (the attribution pair
+        # the solver's one fetch brought back) into the decision log,
+        # the trace events, and the explainer.
         dset = extract_deltas(
             meta, outcome.assignment,
             max_migrations=(
                 self.max_migrations_per_round
                 if self.enable_preemption else 0
             ),
+            task_cost=outcome.task_cost,
+            task_margin=outcome.task_margin,
         )
 
         bindings: dict[str, str] = {}
@@ -1252,11 +1385,15 @@ class SchedulerBridge:
                 _age(d.task, task)
                 continue
             bindings[d.task] = d.machine
-            self.decision_log.append(
-                (self.round_num, "PLACE", d.task, d.machine)
-            )
+            self.decision_log.append((
+                self.round_num, "PLACE", d.task,
+                {"machine": d.machine, "cost": d.cost,
+                 "margin": d.margin},
+            ))
             self.trace.emit("SCHEDULE", task=d.task, machine=d.machine,
-                            round_num=ir.stats.round_num)
+                            round_num=ir.stats.round_num,
+                            detail={"cost": d.cost,
+                                    "margin": d.margin})
             log.info(
                 "round %d: PLACE %s -> %s",
                 ir.stats.round_num, d.task, d.machine,
@@ -1277,12 +1414,14 @@ class SchedulerBridge:
             migrations[d.task] = (d.from_machine, d.machine)
             self.decision_log.append((
                 self.round_num, "MIGRATE", d.task,
-                f"{d.from_machine}->{d.machine}",
+                {"from": d.from_machine, "to": d.machine,
+                 "cost": d.cost, "margin": d.margin},
             ))
             self.trace.emit(
                 "MIGRATE", task=d.task, machine=d.machine,
                 round_num=ir.stats.round_num,
-                detail={"from": d.from_machine},
+                detail={"from": d.from_machine, "cost": d.cost,
+                        "margin": d.margin},
             )
             log.info(
                 "round %d: MIGRATE %s %s -> %s", ir.stats.round_num,
@@ -1294,12 +1433,15 @@ class SchedulerBridge:
                     or task.machine != d.from_machine):
                 continue
             preemptions[d.task] = d.from_machine
-            self.decision_log.append(
-                (self.round_num, "PREEMPT", d.task, d.from_machine)
-            )
+            self.decision_log.append((
+                self.round_num, "PREEMPT", d.task,
+                {"from": d.from_machine, "cost": d.cost,
+                 "margin": d.margin},
+            ))
             self.trace.emit(
                 "PREEMPT", task=d.task, machine=d.from_machine,
                 round_num=ir.stats.round_num,
+                detail={"cost": d.cost, "margin": d.margin},
             )
             log.info(
                 "round %d: PREEMPT %s off %s", ir.stats.round_num,
@@ -1356,6 +1498,16 @@ class SchedulerBridge:
         self.trace.flush()
         if self.metrics is not None:
             self.metrics.record_round(stats)
+        if self.flightrec is not None:
+            self.flightrec.capture_finish(
+                ir.flight, outcome, dataclasses.asdict(stats),
+                extra={
+                    "unscheduled": list(unscheduled),
+                    "deferred": [d.task for d in dset.deferred],
+                },
+            )
+            if flight_dump_why:
+                self.flight_dump("degrade", label=flight_dump_why)
         return RoundResult(
             bindings=bindings, stats=stats, unscheduled=unscheduled,
             migrations=migrations, preemptions=preemptions,
@@ -1386,6 +1538,10 @@ class SchedulerBridge:
                     detail={"error": "fetch abandoned in cancel_round"},
                 )
                 self.trace.flush()
+                self.flight_dump(
+                    "fetch-timeout",
+                    label="fetch abandoned in cancel_round",
+                )
 
     @property
     def solver_timeout_s(self) -> float:

@@ -274,6 +274,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "express batch into the trace stream (inspect "
                         "with python -m poseidon_tpu.trace report / "
                         "chrome)")
+    # the decision-evidence layer (README "Explain & replay"): the
+    # anomaly flight recorder keeps the last K rounds' full solve
+    # inputs in a bounded ring and dumps .npz + JSON on DEGRADE /
+    # EXPRESS_DEGRADE / FETCH_TIMEOUT / resync storms; replay offline
+    # with python -m poseidon_tpu.obs.replay <dump>
+    p.add_argument("--flight_recorder",
+                   default="false", choices=["true", "false"],
+                   help="record the last rounds' full host-side solve "
+                        "inputs (graph, cost inputs, flags, warm "
+                        "seed) in a bounded ring and dump it to "
+                        "--flight_dir on anomalies; replay with "
+                        "python -m poseidon_tpu.obs.replay")
+    p.add_argument("--flight_dir", default="flightrec",
+                   help="directory the flight recorder writes dumps "
+                        "to (.npz array blob + .json manifest per "
+                        "dump)")
+    p.add_argument("--explain", default="", metavar="POD_UID",
+                   help="with --flight_recorder: when the loop exits, "
+                        "print the per-decision cost attribution / "
+                        "unscheduled diagnosis for this pod uid from "
+                        "the last captured round (the on-call's 'why "
+                        "did X land on Y' / 'why is Z still pending' "
+                        "answer)")
     return p
 
 
@@ -409,9 +432,22 @@ def run_loop(args: argparse.Namespace) -> int:
         # the latch owns the poseidon_ready gauge: both flip under one
         # lock, so /readyz and /metrics can never disagree mid-scrape
         health = HealthState(ready_gauge=sched_metrics.ready)
+        # build identity: the poseidon_build_info gauge + the /healthz
+        # JSON echo (one startup-time resolution, never the hot path)
+        from poseidon_tpu.obs import build_info
+
+        binfo = build_info(mesh_width=args.mesh_width)
+        sched_metrics.set_build_info(binfo)
         obs_server = ObsServer(
             sched_metrics.registry, health, port=args.metrics_port,
-            host=args.metrics_host,
+            host=args.metrics_host, build=binfo,
+        )
+    flightrec = None
+    if args.flight_recorder == "true":
+        from poseidon_tpu.obs import FlightRecorder
+
+        flightrec = FlightRecorder(
+            args.flight_dir, metrics=sched_metrics,
         )
     bridge = SchedulerBridge(
         cost_model=args.flow_scheduling_cost_model,
@@ -430,6 +466,7 @@ def run_loop(args: argparse.Namespace) -> int:
         express_max_batch=args.express_max_batch,
         metrics=sched_metrics,
         profile_spans=args.trace_profile == "true",
+        flightrec=flightrec,
     )
     incremental = args.run_incremental_scheduler == "true"
     pipelined = args.round_pipeline == "true"
@@ -518,6 +555,11 @@ def run_loop(args: argparse.Namespace) -> int:
                 for typ, task in delta.pod_events:
                     bridge.observe_pod_event(typ, task)
         bridge.note_watch_activity(delta.resyncs, delta.reconnects)
+        if flightrec is not None:
+            # stamp the applied watch position onto the next round's
+            # flight record, so a dump correlates with the apiserver's
+            # event history
+            bridge.flight_rv = watcher.applied_rv
         return True
 
     def _post_express(result) -> None:
@@ -749,6 +791,28 @@ def run_loop(args: argparse.Namespace) -> int:
             watcher.stop()
         if obs_server is not None:
             obs_server.stop()
+        if args.explain:
+            # the operator's exit question: why did/didn't this pod
+            # place — answered from the last captured round
+            if flightrec is None:
+                log.error(
+                    "--explain needs --flight_recorder=true (the "
+                    "explainer reads the captured round inputs)"
+                )
+            else:
+                from poseidon_tpu.obs.explain import (
+                    ExplainError,
+                    RoundExplainer,
+                    render_explanation,
+                )
+
+                try:
+                    ex = RoundExplainer.from_record(
+                        flightrec.last_round_record()
+                    )
+                    print(render_explanation(ex.explain(args.explain)))
+                except ExplainError as e:
+                    log.error("--explain %s: %s", args.explain, e)
         if stats_fh:
             stats_fh.close()
         if trace_fh:

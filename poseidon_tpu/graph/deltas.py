@@ -40,14 +40,30 @@ class DeltaKind(IntEnum):
     NOOP = 3      # running task keeps its machine
 
 
+# Sentinel for "runner-up margin not computed / no finite alternative"
+# (int64-safe; a real margin can be negative when capacity forces a
+# worse-than-runner-up choice, so 0/-1 cannot be the sentinel).
+MARGIN_UNKNOWN = np.int64(2) ** 62
+
+
 @dataclasses.dataclass(frozen=True)
 class SchedulingDelta:
-    """One typed scheduling decision for one task."""
+    """One typed scheduling decision for one task.
+
+    ``cost`` is the decision's exact int64 route cost under the round's
+    instance (the solver's per-task objective contribution: the chosen
+    machine route for PLACE/MIGRATE/NOOP, the priced unsched route for
+    PREEMPT); ``margin`` is runner-up-minus-chosen — how much worse the
+    next-best alternative was (negative when capacity forced this task
+    off its cheapest machine). Both default to "unknown" when the
+    caller has no per-task cost vector (legacy/flow-only backends)."""
 
     kind: DeltaKind
     task: str            # task uid
     machine: str = ""    # target machine (PLACE/MIGRATE; "" otherwise)
     from_machine: str = ""  # current machine (MIGRATE/PREEMPT/NOOP)
+    cost: int | None = None
+    margin: int | None = None
 
 
 @dataclasses.dataclass
@@ -79,6 +95,8 @@ def extract_deltas(
     assignment: np.ndarray,
     *,
     max_migrations: int = 0,
+    task_cost: np.ndarray | None = None,
+    task_margin: np.ndarray | None = None,
 ) -> DeltaSet:
     """Diff a solved assignment against current placements.
 
@@ -87,6 +105,11 @@ def extract_deltas(
     names where each task runs today (-1 = pending). ``max_migrations``
     bounds MIGRATE+PREEMPT per round (0 = unlimited); excess disruptive
     deltas land in ``deferred`` in task order.
+
+    ``task_cost`` / ``task_margin`` (optional, int64 over task order)
+    stamp each typed delta with its exact route cost and runner-up
+    margin (``ResidentOutcome.task_cost``/``task_margin``); a
+    ``MARGIN_UNKNOWN`` margin entry maps to None.
     """
     asg = np.asarray(assignment, np.int64)
     cur = np.asarray(meta.task_current, np.int64)
@@ -99,8 +122,18 @@ def extract_deltas(
     uids = meta.task_uids
     is_run = cur >= 0
 
+    def _cost(i) -> int | None:
+        return int(task_cost[i]) if task_cost is not None else None
+
+    def _margin(i) -> int | None:
+        if task_margin is None:
+            return None
+        m = int(task_margin[i])
+        return None if m == MARGIN_UNKNOWN else m
+
     place = [
-        SchedulingDelta(DeltaKind.PLACE, uids[i], machine=names[asg[i]])
+        SchedulingDelta(DeltaKind.PLACE, uids[i], machine=names[asg[i]],
+                        cost=_cost(i), margin=_margin(i))
         for i in np.flatnonzero(~is_run & (asg >= 0))
     ]
     unscheduled = [
@@ -109,7 +142,8 @@ def extract_deltas(
     noop = [
         SchedulingDelta(DeltaKind.NOOP, uids[i],
                         machine=names[cur[i]],
-                        from_machine=names[cur[i]])
+                        from_machine=names[cur[i]],
+                        cost=_cost(i), margin=_margin(i))
         for i in np.flatnonzero(is_run & (asg == cur))
     ]
 
@@ -119,10 +153,12 @@ def extract_deltas(
             disruptive.append(SchedulingDelta(
                 DeltaKind.MIGRATE, uids[i], machine=names[asg[i]],
                 from_machine=names[cur[i]],
+                cost=_cost(i), margin=_margin(i),
             ))
         else:
             disruptive.append(SchedulingDelta(
                 DeltaKind.PREEMPT, uids[i], from_machine=names[cur[i]],
+                cost=_cost(i), margin=_margin(i),
             ))
     budget = max_migrations if max_migrations > 0 else len(disruptive)
     granted, deferred = disruptive[:budget], disruptive[budget:]

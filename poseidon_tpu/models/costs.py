@@ -359,27 +359,241 @@ COST_MODEL_SELECTORS: dict[int, str] = {
 }
 
 
-def get_cost_model(name_or_selector: str | int) -> CostModelFn:
-    """Look up a cost model by name or by the reference's integer flag.
-
-    Digit strings count as integer selectors — the flag surface is
-    stringly typed (``--flow_scheduling_cost_model=6``, poseidon.cfg:7).
-    """
+def resolve_cost_model_name(name_or_selector: str | int) -> str:
+    """Canonical registry name for a name or the reference's integer
+    flag. Digit strings count as integer selectors — the flag surface
+    is stringly typed (``--flow_scheduling_cost_model=6``)."""
     if isinstance(name_or_selector, str) and name_or_selector.isdigit():
         name_or_selector = int(name_or_selector)
     if isinstance(name_or_selector, int):
         try:
-            name = COST_MODEL_SELECTORS[name_or_selector]
+            return COST_MODEL_SELECTORS[name_or_selector]
         except KeyError:
             raise KeyError(
                 f"unknown cost model selector {name_or_selector}; "
                 f"known: {sorted(COST_MODEL_SELECTORS)}"
             ) from None
-    else:
-        name = name_or_selector
+    return name_or_selector
+
+
+def get_cost_model(name_or_selector: str | int) -> CostModelFn:
+    """Look up a cost model by name or by the reference's integer flag."""
+    name = resolve_cost_model_name(name_or_selector)
     try:
         return COST_MODELS[name]
     except KeyError:
         raise KeyError(
             f"unknown cost model {name!r}; known: {sorted(COST_MODELS)}"
         ) from None
+
+
+# ---------------------------------------------------------------------------
+# per-term attribution: the explainer's API (obs/explain.py)
+# ---------------------------------------------------------------------------
+#
+# Each model's cost is, by construction, a SUM of named terms per arc
+# (locality, load, wait-aging, fixed channel fees...). The term
+# functions below recompute each model with the identical expressions,
+# split into those named components, and ``_overlay_terms`` applies the
+# ``_finish`` overlays (domain clamp, preemption penalty, hysteresis
+# discount) as explicit adjustment terms — so for every arc the term
+# values sum BIT-EXACTLY to the registry model's priced output on the
+# same backend (asserted by ``tests/test_explain.py`` across models,
+# and by ``arc_cost_terms`` itself at call time). Float-derived
+# quantities (octopus load, wharemap interference, coco fit) are kept
+# as single terms: splitting them would reassociate float arithmetic
+# and break the bit-exactness contract.
+
+
+def _zmask(inputs: CostInputs, mask, value):
+    z = jnp.zeros_like(inputs.kind)
+    return jnp.where(mask, value, z)
+
+
+def _trivial_terms(inputs: CostInputs) -> dict[str, jax.Array]:
+    return {
+        "unsched_base": _zmask(
+            inputs, _kind(inputs, ArcKind.TASK_TO_UNSCHED), 5 * _SCALE
+        ),
+        "wildcard_base": _zmask(
+            inputs, _kind(inputs, ArcKind.TASK_TO_CLUSTER), 2 * _SCALE
+        ),
+    }
+
+
+def _random_terms(inputs: CostInputs) -> dict[str, jax.Array]:
+    # the hash is one indivisible term (there is nothing to attribute)
+    x = (inputs.kind.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         + inputs.task.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+         + inputs.machine.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+         + jnp.uint32(42))
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x2C1B3C6D)
+    x = x ^ (x >> 12)
+    c = (x % jnp.uint32(100)).astype(jnp.int32)
+    c = jnp.where(
+        _kind(inputs, ArcKind.TASK_TO_UNSCHED), COST_CAP // 2, c
+    )
+    return {"hash": c}
+
+
+def _quincy_terms(inputs: CostInputs) -> dict[str, jax.Array]:
+    total = inputs.task_input[inputs.task]
+    remote = jnp.maximum(total - inputs.weight, 0)
+    pref = (_kind(inputs, ArcKind.TASK_TO_MACHINE)
+            | _kind(inputs, ArcKind.TASK_TO_RACK))
+    cluster = _kind(inputs, ArcKind.TASK_TO_CLUSTER)
+    unsched = _kind(inputs, ArcKind.TASK_TO_UNSCHED)
+    wait = jnp.minimum(inputs.task_wait[inputs.task], WAIT_CAP)
+    z = jnp.zeros_like(inputs.kind)
+    return {
+        "remote_data": jnp.where(
+            pref, remote, jnp.where(cluster, total, z)
+        ),
+        "wildcard_base": _zmask(inputs, cluster, _SCALE),
+        "wait_aging": _zmask(inputs, unsched, 5 * _SCALE * wait),
+        "unsched_base": _zmask(inputs, unsched, 5 * _SCALE),
+        "rack_hop": _zmask(
+            inputs, _kind(inputs, ArcKind.RACK_TO_MACHINE), _SCALE // 2
+        ),
+    }
+
+
+def _octopus_terms(inputs: CostInputs) -> dict[str, jax.Array]:
+    load = (inputs.machine_load * 100).astype(jnp.int32)
+    slots = inputs.machine_used_slots * _SCALE
+    routed = (_kind(inputs, ArcKind.CLUSTER_TO_MACHINE)
+              | _kind(inputs, ArcKind.RACK_TO_MACHINE)
+              | _kind(inputs, ArcKind.TASK_TO_MACHINE)
+              | _kind(inputs, ArcKind.MACHINE_TO_SINK))
+    return {
+        "machine_load": _zmask(inputs, routed, load[inputs.machine]),
+        "used_slots": _zmask(inputs, routed, slots[inputs.machine]),
+        "wildcard_base": _zmask(
+            inputs, _kind(inputs, ArcKind.TASK_TO_CLUSTER), _SCALE
+        ),
+        "unsched_base": _zmask(
+            inputs, _kind(inputs, ArcKind.TASK_TO_UNSCHED),
+            COST_CAP // 4,
+        ),
+    }
+
+
+def _wharemap_terms(inputs: CostInputs) -> dict[str, jax.Array]:
+    hunger = jnp.clip(inputs.task_usage[inputs.task]
+                      + inputs.task_cpu[inputs.task].astype(jnp.float32)
+                      / 1000.0, 0.1, 8.0)
+    load = inputs.machine_load[inputs.machine]
+    interf = (hunger * load * 100.0).astype(jnp.int32)
+    direct = (_kind(inputs, ArcKind.TASK_TO_MACHINE)
+              | _kind(inputs, ArcKind.CLUSTER_TO_MACHINE)
+              | _kind(inputs, ArcKind.RACK_TO_MACHINE))
+    return {
+        "interference": _zmask(inputs, direct, interf),
+        "wildcard_base": _zmask(
+            inputs, _kind(inputs, ArcKind.TASK_TO_CLUSTER), 2 * _SCALE
+        ),
+        "unsched_base": _zmask(
+            inputs, _kind(inputs, ArcKind.TASK_TO_UNSCHED),
+            COST_CAP // 4,
+        ),
+    }
+
+
+def _coco_terms(inputs: CostInputs) -> dict[str, jax.Array]:
+    cpu_req = inputs.task_cpu[inputs.task].astype(jnp.float32) / 1000.0
+    mem_req = inputs.task_mem_kb[inputs.task].astype(jnp.float32)
+    cpu_head = jnp.maximum(1.0 - inputs.machine_load[inputs.machine], 0.05)
+    mem_head = jnp.maximum(inputs.machine_mem_free[inputs.machine], 0.05)
+    fit = jnp.maximum(cpu_req / cpu_head,
+                      mem_req / (mem_head * (1 << 20)))
+    sq = jnp.clip(fit, 0.0, 4.0)
+    score = (sq * sq * 100.0).astype(jnp.int32)
+    placing = (_kind(inputs, ArcKind.TASK_TO_MACHINE)
+               | _kind(inputs, ArcKind.CLUSTER_TO_MACHINE)
+               | _kind(inputs, ArcKind.RACK_TO_MACHINE))
+    unsched = _kind(inputs, ArcKind.TASK_TO_UNSCHED)
+    wait = jnp.minimum(inputs.task_wait[inputs.task], WAIT_CAP)
+    return {
+        "resource_fit": _zmask(inputs, placing, score),
+        "wildcard_base": _zmask(
+            inputs, _kind(inputs, ArcKind.TASK_TO_CLUSTER), 3 * _SCALE
+        ),
+        "wait_aging": _zmask(inputs, unsched, 5 * _SCALE * wait),
+        "unsched_base": _zmask(inputs, unsched, COST_CAP // 4),
+    }
+
+
+COST_TERM_FNS: dict[str, Callable[[CostInputs], dict[str, jax.Array]]] = {
+    "trivial": _trivial_terms,
+    "random": _random_terms,
+    "quincy": _quincy_terms,
+    "wharemap": _wharemap_terms,
+    "coco": _coco_terms,
+    "octopus": _octopus_terms,
+}
+
+
+def _overlay_terms(
+    inputs: CostInputs, terms: dict[str, jax.Array]
+) -> dict[str, jax.Array]:
+    """Apply the shared ``_finish`` overlays as explicit adjustment
+    terms, so the returned dict sums to the model's final arc cost on
+    every slot (padding slots included — everything masks to 0)."""
+    raw = None
+    for v in terms.values():
+        raw = v if raw is None else raw + v
+    clipped = jnp.clip(raw, 0, COST_CAP).astype(jnp.int32)
+    running = inputs.task_running[inputs.task]
+    preempt = running & (
+        inputs.kind == jnp.int32(int(ArcKind.TASK_TO_UNSCHED))
+    )
+    after_pre = jnp.where(
+        preempt,
+        jnp.minimum(clipped + PREEMPTION_PENALTY, DOMAIN_SAFE_COST),
+        clipped,
+    )
+    after_disc = jnp.maximum(after_pre - inputs.discount, 0)
+    out = dict(terms)
+    out["domain_clamp"] = clipped - raw
+    out["preemption_penalty"] = after_pre - clipped
+    out["hysteresis_discount"] = after_disc - after_pre
+    return {
+        k: jnp.where(inputs.valid, v, 0) for k, v in out.items()
+    }
+
+
+def arc_cost_terms(
+    name_or_selector: str | int, inputs: CostInputs
+) -> dict[str, np.ndarray]:
+    """Named per-arc cost terms for a registry model, as HOST arrays.
+
+    The returned ``{term_name: int32[E]}`` values sum bit-exactly to
+    ``get_cost_model(name)(inputs)`` on the same backend — verified at
+    call time (a mismatch raises, so the explainer can never report a
+    breakdown that does not add up to the solver's arc cost). Zero-
+    everywhere terms are kept: consumers drop them per decision."""
+    name = resolve_cost_model_name(name_or_selector)
+    try:
+        raw_fn = COST_TERM_FNS[name]
+    except KeyError:
+        raise KeyError(
+            f"no term attribution for cost model {name!r}; "
+            f"known: {sorted(COST_TERM_FNS)}"
+        ) from None
+    terms_dev = _overlay_terms(inputs, raw_fn(inputs))
+    total_dev = COST_MODELS[name](inputs)
+    host = jax.device_get((terms_dev, total_dev))
+    terms = {k: np.asarray(v, np.int32) for k, v in host[0].items()}
+    total = np.asarray(host[1], np.int32)
+    acc = np.zeros_like(total, np.int64)
+    for v in terms.values():
+        acc += v
+    if not np.array_equal(acc, total.astype(np.int64)):
+        bad = int(np.flatnonzero(acc != total)[0])
+        raise AssertionError(
+            f"term breakdown for model {name!r} does not sum to the "
+            f"priced arc cost (first mismatch at arc {bad}: "
+            f"{int(acc[bad])} != {int(total[bad])})"
+        )
+    return terms

@@ -26,17 +26,31 @@ surface, in three pieces:
   by lane/build-mode, express event-to-bind percentiles,
   degrade/resync/timeout tallies with reasons, placement-churn
   summaries.
+- ``explain`` + ``flightrec`` + ``replay``: the decision-evidence
+  layer (README "Explain & replay"). ``explain`` decomposes any
+  decision's cost into named model terms that sum bit-exactly to the
+  solver's arc cost and diagnoses unscheduled pods with a validated
+  minimal relaxation; ``flightrec`` keeps a bounded ring of the last K
+  rounds' full solve inputs and dumps it on anomalies (DEGRADE,
+  EXPRESS_DEGRADE, FETCH_TIMEOUT, resync storms) or on demand;
+  ``python -m poseidon_tpu.obs.replay`` re-runs a dump through the
+  real solve path offline and asserts bit-identity with the recorded
+  assignment/cost, reporting divergence instead of crashing.
 """
 
+from poseidon_tpu.obs.flightrec import FlightRecorder
 from poseidon_tpu.obs.metrics import (
     MetricsRegistry,
     SchedulerMetrics,
+    build_info,
 )
 from poseidon_tpu.obs.server import HealthState, ObsServer
 
 __all__ = [
+    "FlightRecorder",
     "HealthState",
     "MetricsRegistry",
     "ObsServer",
     "SchedulerMetrics",
+    "build_info",
 ]

@@ -365,6 +365,18 @@ class SchedulerMetrics:
             "the /readyz latch: 1 after seed LIST + first round over "
             "real state (certified solve or proven-empty)",
         )
+        self.flightrec_dumps = registry.counter(
+            "poseidon_flightrec_dumps_total",
+            "anomaly flight-recorder dumps written, by (bounded) "
+            "reason (degrade/express-degrade/fetch-timeout/"
+            "resync-storm/manual)",
+        )
+        self.build_info = registry.gauge(
+            "poseidon_build_info",
+            "constant 1; the labels carry the build identity "
+            "(package version, jax version, backend, mesh_width) — "
+            "join on it to slice any series by deploy",
+        )
         # ---- the service lane (multi-tenant batching, service/) ----
         # tenant labels are BOUNDED at the service layer: the first
         # service.MAX_TENANT_LABELS registered tenants keep their id,
@@ -475,6 +487,19 @@ class SchedulerMetrics:
         metrics twin)."""
         self.degrades.inc(why=why)
 
+    def record_flightrec_dump(self, reason: str) -> None:
+        """One flight-recorder dump written (reason is the recorder's
+        own bounded vocabulary, flightrec.DUMP_REASONS)."""
+        self.flightrec_dumps.inc(reason=reason)
+
+    def set_build_info(self, info: dict) -> None:
+        """Publish the build-identity gauge (value 1, labels = the
+        ``build_info()`` dict). Called once at daemon startup; also
+        echoed in the /healthz JSON body."""
+        self.build_info.set(1, **{
+            k: str(v) for k, v in info.items()
+        })
+
     # ---- express lane --------------------------------------------------
 
     def record_express_batch(self, e2b_ms_samples) -> None:
@@ -558,3 +583,26 @@ def _bounded_why(why: str) -> str:
         if needle in why:
             return label
     return "vocabulary"
+
+
+def build_info(mesh_width: int = 0) -> dict:
+    """The build-identity labelset shared by the
+    ``poseidon_build_info`` gauge and the ``/healthz`` JSON body:
+    package version, jax version, the resolved jax backend, and the
+    configured mesh width. Called once at daemon startup (resolving
+    the backend initializes it — never on the hot path)."""
+    import jax
+
+    import poseidon_tpu
+
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:  # no backend available at all
+        backend = "none"
+    return {
+        "package": "poseidon-tpu",
+        "version": poseidon_tpu.__version__,
+        "jax": jax.__version__,
+        "backend": backend,
+        "mesh_width": mesh_width,
+    }

@@ -62,9 +62,13 @@ def _why_of(detail) -> str:
     )
 
 
-def analyze_trace(path: str) -> dict:
+def analyze_trace(path: str, *, tenant: str = "") -> dict:
     """One pass over the trace -> the report's data model (a plain
-    JSON-able dict; ``render_report`` formats it for humans)."""
+    JSON-able dict; ``render_report`` formats it for humans).
+
+    ``tenant`` filters to one service-lane session's events (every
+    tenant's generator stamps its id; "" = no filter, the whole
+    stream)."""
     lane_lat: dict[tuple[str, str], list[float]] = (
         collections.defaultdict(list)
     )
@@ -73,7 +77,8 @@ def analyze_trace(path: str) -> dict:
     tallies: dict[str, collections.Counter] = {
         k: collections.Counter()
         for k in ("DEGRADE", "EXPRESS_DEGRADE", "WATCH_RESYNC",
-                  "WATCH_RECONNECT", "FETCH_TIMEOUT")
+                  "WATCH_RECONNECT", "FETCH_TIMEOUT",
+                  "FLIGHTREC_DUMP")
     }
     churn = collections.Counter()
     span_phases: dict[str, list[float]] = collections.defaultdict(list)
@@ -84,6 +89,8 @@ def analyze_trace(path: str) -> dict:
     bind_failures = 0
     first_round = last_round = None
     for ev in read_trace(path):
+        if tenant and ev.tenant != tenant:
+            continue
         if ev.event == "ROUND":
             rounds += 1
             if first_round is None:
@@ -132,6 +139,7 @@ def analyze_trace(path: str) -> dict:
             churn[ev.event] += 1
     per_round = max(nonempty_rounds, 1)
     return {
+        "tenant": tenant,
         "rounds": rounds,
         "nonempty_rounds": nonempty_rounds,
         "round_range": [first_round, last_round],
@@ -170,6 +178,8 @@ def render_report(data: dict) -> str:
     add = out.append
     lo, hi = data["round_range"]
     add("== poseidon-tpu trace report ==")
+    if data.get("tenant"):
+        add(f"tenant: {data['tenant']}")
     add(
         f"rounds: {data['rounds']} "
         f"({data['nonempty_rounds']} with a solve), "

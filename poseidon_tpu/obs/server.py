@@ -10,7 +10,10 @@ the discipline). Endpoints:
 - ``GET /healthz``: process liveness — 200 as long as the daemon can
   answer at all (the loop owns no state a liveness probe should gate
   on; a wedged round shows up in ``/readyz`` and the metrics, not
-  here);
+  here). The body is JSON: ``{"status": "ok", "build": {...}}`` with
+  the same build-identity labelset the ``poseidon_build_info`` gauge
+  publishes (package/jax versions, backend, mesh width) — so "what
+  exactly is this pod running" is one curl, not a registry query;
 - ``GET /readyz``: readiness — 200 only after BOTH (a) the seed
   LIST/snapshot has been applied to the bridge and (b) the first
   scheduling round over that real cluster state has completed (every
@@ -33,6 +36,7 @@ directly.
 from __future__ import annotations
 
 import http.server
+import json
 import logging
 import threading
 
@@ -116,17 +120,24 @@ class ObsServer:
         *,
         port: int = 0,
         host: str = "0.0.0.0",
+        build: dict | None = None,
     ):
         self.registry = registry
         self.health = health
         self.host = host
         self.port = port
+        # the /healthz build-identity echo (obs.metrics.build_info());
+        # immutable after start, so handler threads read it lock-free
+        self.build = dict(build or {})
         self._httpd: http.server.ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     def start(self) -> int:
         registry = self.registry
         health = self.health
+        healthz_body = json.dumps(
+            {"status": "ok", "build": self.build}
+        ).encode() + b"\n"
 
         class _Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # pta: background-thread
@@ -141,9 +152,10 @@ class ObsServer:
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
                 elif route == "/healthz":
-                    body = b"ok\n"
+                    body = healthz_body
                     self.send_response(200)
-                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Type",
+                                     "application/json")
                 elif route == "/readyz":
                     if health.ready:
                         body = b"ready\n"

@@ -276,6 +276,34 @@ def _finalize(dev: DenseInstance, dt: DenseTopology, pc_s, ra_s, asg):
     return ch, primal
 
 
+def _decision_stats(dev: DenseInstance, asg):
+    """Per-decision attribution over the final assignment: the chosen
+    route's SCALED cost and the runner-up alternative's SCALED cost.
+
+    For a placed task the runner-up is the cheapest of {any other
+    machine column, going unscheduled}; for an unscheduled task it is
+    the cheapest machine column. Both ride the round's one batched
+    fetch; the caller unscales (costs are scale multiples) and maps an
+    INF alternative to "no finite runner-up". Under aggregation the
+    columns are equivalence classes, so the margin is vs the next
+    DISTINCT alternative — same-class members are cost-equal by
+    construction. Traced inside ``_resident_chain`` (one program, no
+    extra dispatch); the masked row-min is one O(Tp·Mp) pass over a
+    table the solve already materialized."""
+    Tp, Mp = dev.c.shape
+    on = (asg >= 0) & (asg < Mp) & dev.task_valid
+    m = jnp.clip(asg, 0, Mp - 1)
+    c_asg = jnp.take_along_axis(dev.c, m[:, None], axis=1)[:, 0]
+    chosen = jnp.where(on, c_asg, dev.u)
+    cols = jnp.arange(Mp, dtype=I32)
+    masked = jnp.where(
+        (cols[None, :] == asg[:, None]) & on[:, None], INF, dev.c
+    )
+    alt_m = jnp.min(masked, axis=1)
+    alt = jnp.where(on, jnp.minimum(alt_m, dev.u), alt_m)
+    return chosen, alt
+
+
 # ---------------------------------------------------------------------------
 # the express lane: on-HBM patch + bounded eps=1 repair between rounds
 # ---------------------------------------------------------------------------
@@ -601,6 +629,7 @@ def _resident_chain(
             max_rounds=max_rounds, smax=smax, analytic_init=True,
         )
     ch, primal = _finalize(dev, dt, pc_s, ra_s, asg)
+    chosen, alt = _decision_stats(dev, asg)
     # flat tuple out (DenseState is not a registered pytree); the
     # caller reassembles the warm handle host-side. ``cost`` rides
     # along so oracle-fallback paths reuse the priced arc table
@@ -608,9 +637,12 @@ def _resident_chain(
     # ``dev`` (the densified on-HBM instance — its arrays are aliases
     # of buffers the program produced anyway) rides along so the
     # express lane can keep the warm table resident and patch it in
-    # place between rounds instead of re-densifying.
+    # place between rounds instead of re-densifying. ``chosen``/``alt``
+    # are the per-decision attribution pair (scaled chosen route cost +
+    # runner-up alternative) the decision log and the explainer
+    # consume — computed here so they ride the round's ONE fetch.
     return (asg, lvl, floor, gap, converged, rounds, phases, ch,
-            primal, domain_ok, cost, dev)
+            primal, domain_ok, chosen, alt, cost, dev)
 
 
 @dataclasses.dataclass
@@ -628,6 +660,13 @@ class ResidentOutcome:
     # outcome cannot be flow-decomposed
     topology: TransportTopology | None
     timings: dict[str, float]
+    # per-decision attribution (int64 over task order, unscaled): the
+    # chosen route's exact objective contribution and runner-up-minus-
+    # chosen (deltas.MARGIN_UNKNOWN = no finite runner-up / margin not
+    # computed on this backend). None only when the path cannot price
+    # decisions at all (non-taxonomy oracle graphs).
+    task_cost: np.ndarray | None = None
+    task_margin: np.ndarray | None = None
 
 
 @dataclasses.dataclass
@@ -972,10 +1011,91 @@ class ResidentSolver:
         self._express: _ExpressContext | None = None
         # lifetime sanctioned express fetches (one per express batch)
         self.express_fetches = 0
+        # host mirror of the warm state (asg/lvl/floor from the round's
+        # own batched fetch) + whether an express batch has since
+        # mutated the on-HBM warm state without a full-state fetch —
+        # the flight recorder's replay-seed surface (obs/flightrec.py)
+        self._warm_seed: tuple | None = None
+        self._warm_mutated = True
 
     def reset(self) -> None:
         self._warm = None
         self._express = None
+        self._warm_seed = None
+        self._warm_mutated = True
+
+    @property
+    def warm_seed_host(self) -> tuple | None:
+        """Host (asg, lvl, floor) int32 mirror of the live warm state,
+        or None when cold / the mirror is stale (an express batch
+        patched the warm state on device since the last full-state
+        fetch — replaying the recorded express batches reproduces it
+        instead)."""
+        if self._warm is None or self._warm_mutated:
+            return None
+        return self._warm_seed
+
+    @property
+    def pad_floors(self) -> dict[str, int]:
+        """The grow-only padding-bucket floors as of now. Captured by
+        the flight recorder AFTER ``begin_round`` (which updates them),
+        so a replay padding with these floors reproduces the round's
+        exact static shapes (Tp/Mp/P/smax) regardless of what earlier
+        rounds grew them to."""
+        return {
+            "e": self._e_floor, "t": self._t_floor, "m": self._m_floor,
+            "ti": self._ti_floor, "mi": self._mi_floor,
+            "s": self._s_floor, "p": self._p_floor,
+        }
+
+    def restore_for_replay(
+        self, floors: dict[str, int] | None,
+        warm_seed: tuple | None,
+    ) -> None:
+        """Offline-replay seeding (obs/replay.py): restore recorded
+        padding floors and (optionally) upload a recorded warm
+        (asg, lvl, floor) mirror as the next round's warm start — the
+        recorded round then re-runs the exact compiled program the live
+        round ran, from the same starting state, so the replayed
+        assignment/cost are bit-identical. Never called on the live
+        path."""
+        if floors:
+            self._e_floor = floors["e"]
+            self._t_floor = floors["t"]
+            self._m_floor = floors["m"]
+            self._ti_floor = floors["ti"]
+            self._mi_floor = floors["mi"]
+            self._s_floor = floors["s"]
+            self._p_floor = floors["p"]
+        if warm_seed is None:
+            return
+        asg = np.asarray(warm_seed[0], np.int32)  # noqa: PTA001 -- recorded host arrays (offline replay path, never the live round)
+        lvl = np.asarray(warm_seed[1], np.int32)  # noqa: PTA001 -- recorded host arrays (offline replay path)
+        floor = np.asarray(warm_seed[2], np.int32)  # noqa: PTA001 -- recorded host arrays (offline replay path)
+        if self._mesh is not None:
+            # the sharded lane's warm state lives task-sharded /
+            # machine-replicated; committing the seed to one device
+            # would make the next dispatch a disallowed reshard
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            axis = self._mesh.axis_names[0]
+            task_s = NamedSharding(self._mesh, PartitionSpec(axis))
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            asg_d, lvl_d, floor_d = jax.device_put(
+                (asg, lvl, floor), (task_s, task_s, repl)
+            )
+        else:
+            asg_d, lvl_d, floor_d = jax.device_put((asg, lvl, floor))
+        # gap/converged/rounds/phases are never read on the warm-start
+        # path (_resident_chain consumes asg/lvl/floor only), so int32
+        # placeholders avoid an x64-mode dependency here
+        self._warm = DenseState(
+            asg=asg_d, lvl=lvl_d, floor=floor_d,
+            gap=jnp.int32(0), converged=jnp.asarray(True),
+            rounds=jnp.int32(0), phases=jnp.int32(0),
+        )
+        self._warm_seed = (asg, lvl, floor)
+        self._warm_mutated = False
 
     @property
     def express_ready(self) -> bool:
@@ -1261,8 +1381,8 @@ class ResidentSolver:
             t_dispatch = time.perf_counter()
             with enable_x64(True):
                 (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
-                 phases_d, ch_dev, primal, domain_ok, cost_dev,
-                 dev_inst) = (
+                 phases_d, ch_dev, primal, domain_ok, chosen_d, alt_d,
+                 cost_dev, dev_inst) = (
                     _resident_chain(
                         dt, inputs_dev,
                         warm.asg if warm is not None else zeros_t,
@@ -1279,10 +1399,15 @@ class ResidentSolver:
             )
 
         def _fetch():
+            # one batched download: placements + certificate + the per-
+            # decision attribution pair + the warm-state mirror (lvl/
+            # floor) the flight recorder seeds replays from — MORE
+            # bytes on the same single sync, never a second sync
             with sanctioned_transfer():
                 vals = jax.device_get((  # noqa: PTA001 -- THE round's one sanctioned placement fetch (module docstring)
                     state.asg, ch_dev, state.converged, state.rounds,
-                    state.phases, primal, domain_ok,
+                    state.phases, primal, domain_ok, chosen_d, alt_d,
+                    state.lvl, state.floor,
                 ))
             return vals, time.perf_counter()
 
@@ -1356,7 +1481,8 @@ class ResidentSolver:
         T = inflight.T
         t0 = time.perf_counter()
         try:
-            (asg_np, ch_np, conv, rounds, phases, primal_np, dom_ok), \
+            (asg_np, ch_np, conv, rounds, phases, primal_np, dom_ok,
+             chosen_np, alt_np, lvl_np, floor_np), \
                 t_done = inflight.future.result(
                     timeout_s=self._fetch_deadline_s()
                 )
@@ -1401,8 +1527,8 @@ class ResidentSolver:
             with no_implicit_transfers():
                 with enable_x64(True):
                     (asg_d, lvl_d, floor_d, gap_d, conv_d, rounds_d,
-                     phases_d, ch_dev, primal, _dom, cost_dev,
-                     dev_inst) = (
+                     phases_d, ch_dev, primal, _dom, chosen_d, alt_d,
+                     cost_dev, dev_inst) = (
                         _resident_chain(
                             inflight.dt, inflight.inputs_dev, zeros_t,
                             zeros_t, zeros_m,
@@ -1422,10 +1548,12 @@ class ResidentSolver:
             inflight.dev = dev_inst
             self.last_round_fetches += 1
             with sanctioned_transfer():
-                asg_np, ch_np, conv, rounds, phases, primal_np = (
+                (asg_np, ch_np, conv, rounds, phases, primal_np,
+                 chosen_np, alt_np, lvl_np, floor_np) = (
                     jax.device_get((  # noqa: PTA001 -- sanctioned second fetch of the cold retry (this round really does pay twice)
                         state.asg, ch_dev, state.converged, state.rounds,
-                        state.phases, primal,
+                        state.phases, primal, chosen_d, alt_d,
+                        state.lvl, state.floor,
                     ))
                 )
             timings["solve_ms"] += (time.perf_counter() - t0) * 1000
@@ -1437,8 +1565,28 @@ class ResidentSolver:
             )
 
         self._warm = state
+        # host mirror of the committed warm state (already-fetched
+        # arrays riding the round's one sync): the flight recorder's
+        # replay seed. Valid until an express batch mutates the warm
+        # state on device without a full-state fetch.
+        self._warm_seed = (
+            np.asarray(asg_np, np.int32),  # noqa: PTA001 -- already-fetched host data
+            np.asarray(lvl_np, np.int32),  # noqa: PTA001 -- already-fetched host data
+            np.asarray(floor_np, np.int32),  # noqa: PTA001 -- already-fetched host data
+        )
+        self._warm_mutated = False
         Mp = inflight.Mp
         asg = np.asarray(asg_np[:T], np.int32)  # noqa: PTA001 -- asg_np is already-fetched HOST data (the sanctioned fetch above)
+        scale = np.int64(T + 1)
+        chosen64 = np.asarray(chosen_np, np.int64)[:T]  # noqa: PTA001 -- already-fetched host data
+        alt64 = np.asarray(alt_np, np.int64)[:T]  # noqa: PTA001 -- already-fetched host data
+        task_cost = chosen64 // scale
+        from poseidon_tpu.graph.deltas import MARGIN_UNKNOWN
+
+        task_margin = np.where(
+            alt64 >= int(INF), MARGIN_UNKNOWN,
+            alt64 // scale - task_cost,
+        )
         plan = inflight.agg_plan
         if plan is not None:
             # scale lane: the solve ran over equivalence-class columns;
@@ -1493,6 +1641,8 @@ class ResidentSolver:
             phases=int(phases),
             topology=topo,
             timings=timings,
+            task_cost=task_cost,
+            task_margin=task_margin,
         )
 
 
@@ -1873,6 +2023,11 @@ class ResidentSolver:
                 asg=asg_f, lvl=lvl_f, floor=floor_f, gap=gap,
                 converged=conv, rounds=rounds_d, phases=phases,
             )
+            # the warm state moved on device without a full-state
+            # fetch: the host mirror is stale until the next round
+            # (replays reproduce this window by re-running the
+            # recorded express batches instead)
+            self._warm_mutated = True
             placements: list[tuple[str, str]] = []
             for i in range(int(n_chg)):
                 r = int(rows_np[i])
@@ -1895,6 +2050,73 @@ class ResidentSolver:
             self._express = None
             return ExpressOutcome(ok=False, reason=str(e),
                                   timings=timings)
+
+    # margin on the oracle degrade path needs the full [T, M] route
+    # table on host; above this many cells it is skipped (cost still
+    # computed — margins report MARGIN_UNKNOWN). Degraded rounds are
+    # the rare path, and a memory-envelope degrade is by definition a
+    # table too big to materialize anywhere.
+    ORACLE_MARGIN_CELLS = 1 << 22
+
+    @staticmethod
+    def _host_decision_stats(topo, cost_host, asg):
+        """Host twin of ``_decision_stats`` for oracle-solved rounds:
+        per-task chosen route cost + runner-up alternative from the
+        priced arc table (vectorized numpy; the chosen-route part is
+        O(T·P), the runner-up part O(T·M) and skipped over the cell
+        budget)."""
+        from poseidon_tpu.graph.deltas import MARGIN_UNKNOWN
+        from poseidon_tpu.ops.transport import (
+            INF as TINF,
+            instance_from_topology,
+        )
+
+        inst = instance_from_topology(topo, cost_host)
+        T, M = inst.n_tasks, inst.n_machines
+        if T == 0:
+            z = np.zeros(0, np.int64)
+            return z, z
+        asg = np.asarray(asg, np.int64)  # noqa: PTA001 -- oracle-path input is host data (the degrade path already downloaded everything)
+        on = asg >= 0
+        m = np.clip(asg, 0, max(M - 1, 0))
+        best = np.where(on, inst.w + inst.d[m], TINF)
+        hit_m = inst.pref_machine == asg[:, None]
+        pc = np.where(hit_m, inst.pref_cost, TINF)
+        hit_r = (inst.pref_rack >= 0) & (
+            inst.pref_rack == inst.rack_of[m][:, None]
+        )
+        pc = np.minimum(
+            pc, np.where(hit_r, inst.pref_cost + inst.ra[m][:, None],
+                         TINF)
+        )
+        best = np.minimum(best, pc.min(axis=1, initial=TINF))
+        chosen = np.where(on, best, inst.u).astype(np.int64)
+        if T * M > ResidentSolver.ORACLE_MARGIN_CELLS:
+            return chosen, np.full(T, MARGIN_UNKNOWN, np.int64)
+        # full route table [T, M]: cluster channel + pref channels
+        row = inst.w[:, None] + inst.d[None, :]
+        for k in range(inst.max_prefs):
+            pm = inst.pref_machine[:, k: k + 1]
+            pr = inst.pref_rack[:, k: k + 1]
+            pck = inst.pref_cost[:, k: k + 1]
+            mids = np.arange(M)[None, :]
+            row = np.minimum(
+                row, np.where((pm == mids) & (pm >= 0), pck, TINF)
+            )
+            hit = (pr >= 0) & (pr == inst.rack_of[None, :])
+            row = np.minimum(
+                row, np.where(hit, pck + inst.ra[None, :], TINF)
+            )
+        masked = np.where(
+            (np.arange(M)[None, :] == asg[:, None]) & on[:, None],
+            TINF, row,
+        )
+        alt_m = masked.min(axis=1, initial=TINF)
+        alt = np.where(on, np.minimum(alt_m, inst.u), alt_m)
+        margin = np.where(
+            alt >= TINF, MARGIN_UNKNOWN, alt - chosen
+        ).astype(np.int64)
+        return chosen, margin
 
     def _oracle_round(
         self, arrays, meta, topo, cost_dev, timings, *, why: str
@@ -1936,6 +2158,7 @@ class ResidentSolver:
             m = placements.get(uid)
             if m is not None:
                 asg[i] = midx[m]
+        task_cost = task_margin = None
         if topo is not None:
             # real channel codes, so the outcome remains
             # flow-decomposable just like a dense one
@@ -1943,6 +2166,9 @@ class ResidentSolver:
 
             channel = _channels_for(
                 instance_from_topology(topo, cost_host), asg
+            )
+            task_cost, task_margin = self._host_decision_stats(
+                topo, cost_host, asg
             )
         else:
             channel = np.full(T, -1, np.int32)
@@ -1963,4 +2189,6 @@ class ResidentSolver:
             phases=0,
             topology=topo,
             timings=timings,
+            task_cost=task_cost,
+            task_margin=task_margin,
         )

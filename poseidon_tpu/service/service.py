@@ -128,6 +128,10 @@ class SchedulingService:
             raise ValueError(f"tenant {tenant_id!r} already registered")
         solver = TenantSolver(tenant_id, self.dispatcher)
         tr = trace or TraceGenerator()
+        # every event this session emits carries the tenant id,
+        # so N tenants can share one trace sink and still be
+        # reported individually (trace report --tenant)
+        tr.tenant = tenant_id
         bridge = SchedulerBridge(
             cost_model=cost_model,
             max_tasks_per_machine=max_tasks_per_machine,

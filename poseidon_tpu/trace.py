@@ -93,6 +93,9 @@ EVENT_TYPES = frozenset({
     "EXPRESS_DEGRADE",  # express batch fell back to the round path
     "SPAN",             # per-round/per-batch phase span tree
                         # (--trace_profile; obs/spans.py schema)
+    "FLIGHTREC_DUMP",   # the anomaly flight recorder wrote a dump
+                        # (detail.reason names the trigger, detail.path
+                        # the manifest; obs/flightrec.py)
 })
 
 
@@ -104,19 +107,29 @@ class TraceEvent:
     machine: str = ""
     round_num: int = 0
     detail: dict | None = None
+    # which tenant's session emitted this (the service lane writes all
+    # tenants' streams into ONE file; "" = single-tenant daemon)
+    tenant: str = ""
 
 
 class TraceGenerator:
-    """Appends one JSON object per line to ``sink`` (file-like)."""
+    """Appends one JSON object per line to ``sink`` (file-like).
+
+    ``tenant`` stamps every emitted event — the service lane
+    (poseidon_tpu/service/) gives each tenant session its own generator
+    over one shared sink, and ``python -m poseidon_tpu.trace report
+    --tenant <id>`` filters on the stamp."""
 
     def __init__(
         self,
         sink: IO[str] | None = None,
         clock_us: Callable[[], int] | None = None,
         buffer_events: int = 10_000,
+        tenant: str = "",
     ):
         self.sink = sink
         self.clock_us = clock_us or (lambda: int(time.time() * 1e6))
+        self.tenant = tenant
         # with no sink, keep a bounded ring (a daemon running forever
         # must not accumulate events without bound)
         self.events: collections.deque[TraceEvent] = collections.deque(
@@ -144,6 +157,7 @@ class TraceGenerator:
             machine=machine,
             round_num=round_num,
             detail=detail,
+            tenant=self.tenant,
         )
         if self.sink is not None:
             self.sink.write(json.dumps(dataclasses.asdict(ev)) + "\n")
@@ -178,14 +192,33 @@ def read_trace(path: str):
     fields this reader does not know. Unknown keys are dropped (one
     warning per file naming them) instead of raising ``TypeError`` —
     an old analysis binary must still read a new daemon's trace.
+
+    Torn tails: a process killed mid-``write`` (crash, OOM-kill — the
+    flight recorder exists for exactly these) leaves a truncated FINAL
+    line. That is a normal post-mortem artifact, not corruption: the
+    reader drops it with one warning and yields everything before it.
+    A malformed line anywhere ELSE still raises
+    ``json.JSONDecodeError`` — mid-file corruption is real corruption.
     """
     dropped: set[str] = set()
     events: list[TraceEvent] = []
+    # torn-tail tolerance is one-line deferral, streaming: hold a
+    # parse failure and forgive it only if no later non-blank line
+    # follows (loading the whole file just to find the last line would
+    # double the report's peak memory on multi-hundred-MB daemon
+    # traces)
+    pending_error: json.JSONDecodeError | None = None
     with open(path) as fh:
         for line in fh:
             if not line.strip():
                 continue
-            doc = json.loads(line)
+            if pending_error is not None:
+                raise pending_error  # garbage mid-file: real corruption
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError as e:
+                pending_error = e
+                continue
             unknown = doc.keys() - _EVENT_FIELDS
             if unknown:
                 dropped |= unknown
@@ -193,6 +226,11 @@ def read_trace(path: str):
                     k: v for k, v in doc.items() if k in _EVENT_FIELDS
                 }
             events.append(TraceEvent(**doc))
+    if pending_error is not None:
+        log.warning(
+            "read_trace(%s): dropping truncated final line "
+            "(crash mid-write?)", path,
+        )
     if dropped:
         log.warning(
             "read_trace(%s): dropped unknown field(s) %s — trace "
@@ -225,6 +263,10 @@ def main(argv: list[str] | None = None) -> int:
     rep.add_argument("file")
     rep.add_argument("--json", action="store_true",
                      help="emit the raw data model as JSON")
+    rep.add_argument("--tenant", default="",
+                     help="report only one tenant's events (the "
+                          "service lane stamps each session's tenant "
+                          "id onto its trace events)")
     chrome = sub.add_parser(
         "chrome",
         help="export SPAN events (--trace_profile) as Chrome-trace/"
@@ -239,7 +281,7 @@ def main(argv: list[str] | None = None) -> int:
     from poseidon_tpu.obs import spans as _spans
 
     if args.cmd == "report":
-        data = _report.analyze_trace(args.file)
+        data = _report.analyze_trace(args.file, tenant=args.tenant)
         if args.json:
             print(json.dumps(data, indent=2))
         else:

@@ -1,0 +1,360 @@
+"""Explainer: per-decision term attribution + unscheduled diagnosis.
+
+The acceptance contract (ISSUE 12): across fuzzed rounds over >= 3
+cost models with preemption on AND off, every decision's term
+breakdown sums bit-exactly to the solver's arc cost (the device-
+fetched ``cost`` in the decision log), and every unscheduled pod's
+diagnosis is validated by applying its minimal relaxation and
+re-solving — the pod places.
+"""
+
+import pytest
+
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.cluster import Machine, Task, TaskPhase
+from poseidon_tpu.obs.explain import (
+    ExplainError,
+    RoundExplainer,
+    render_explanation,
+)
+from poseidon_tpu.obs.flightrec import FlightRecorder
+from poseidon_tpu.synth import make_synthetic_cluster
+
+MODELS = ("quincy", "octopus", "coco", "wharemap", "trivial")
+
+
+def _session(model, *, preempt=False, seed=3, machines=10, pods=40,
+             rounds=2, prefs=2, **kw):
+    """A small recorded session: seed round + churn rounds; returns
+    (bridge, recorder)."""
+    fr = FlightRecorder("unused-dir", rounds=4)
+    bridge = SchedulerBridge(
+        cost_model=model, small_to_oracle=False, flightrec=fr,
+        enable_preemption=preempt, **kw,
+    )
+    cluster = make_synthetic_cluster(
+        machines, pods, seed=seed, prefs_per_task=prefs
+    )
+    bridge.observe_nodes(list(cluster.machines))
+    bridge.observe_pods(list(cluster.tasks))
+    for _ in range(rounds):
+        res = bridge.run_scheduler()
+        for uid, m in res.bindings.items():
+            bridge.confirm_binding(uid, m)
+        for uid, (_f, to) in res.migrations.items():
+            bridge.confirm_migration(uid, to)
+        for uid in res.preemptions:
+            bridge.confirm_preemption(uid)
+    return bridge, fr
+
+
+class TestAttributionExactness:
+    @pytest.mark.parametrize("model", MODELS)
+    @pytest.mark.parametrize("preempt", [False, True])
+    def test_terms_sum_to_the_solvers_cost(self, model, preempt):
+        """For every logged decision: the explainer's term breakdown
+        sums to its own cost, that cost equals the DEVICE-computed
+        cost the decision log carries, the margins agree, and the
+        per-task costs sum to the round's exact objective."""
+        bridge, fr = _session(model, preempt=preempt)
+        rec = fr.last_round_record()
+        assert rec is not None and rec.result is not None
+        ex = RoundExplainer.from_record(rec)
+        checked = 0
+        for rnd, kind, uid, detail in bridge.decision_log:
+            if rnd != rec.round_num or not isinstance(detail, dict):
+                continue
+            if "cost" not in detail or detail["cost"] is None:
+                continue
+            e = ex.explain(uid)
+            assert sum(e.terms.values()) == e.cost, (uid, e.terms)
+            assert e.cost == detail["cost"], (kind, uid, e, detail)
+            if detail.get("margin") is not None:
+                assert e.margin == detail["margin"], (kind, uid)
+            checked += 1
+        # attribution covers the whole objective, not just deltas
+        total = sum(
+            ex.explain(u).cost for u in rec.meta.task_uids
+        )
+        assert total == rec.result["cost"]
+        # first (seed) rounds always log placements; later rounds may
+        # be all-NOOP — at least one recorded round must have checked
+        # something across the ring
+        if checked == 0:
+            first = next(
+                r for r in fr.records if r.kind == "round"
+            )
+            ex0 = RoundExplainer.from_record(first)
+            n0 = 0
+            for rnd, kind, uid, detail in bridge.decision_log:
+                if rnd != first.round_num or \
+                        not isinstance(detail, dict):
+                    continue
+                if detail.get("cost") is None:
+                    continue
+                e = ex0.explain(uid)
+                assert sum(e.terms.values()) == e.cost
+                assert e.cost == detail["cost"]
+                n0 += 1
+            assert n0 > 0
+
+    def test_migrate_decisions_attributed(self):
+        """Rebalancing decisions carry cost+margin and explain as
+        MIGRATE: pods adopted RUNNING away from their data land back
+        via a migration whose breakdown sums exactly."""
+        fr = FlightRecorder("unused", rounds=2)
+        bridge = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False, flightrec=fr,
+            enable_preemption=True, migration_hysteresis=1,
+        )
+        nodes = [
+            Machine(name=f"m{i}", cpu_capacity=16.0,
+                    cpu_allocatable=16.0,
+                    memory_capacity_kb=1 << 20,
+                    memory_allocatable_kb=1 << 20,
+                    max_tasks=4, rack=f"r{i % 2}")
+            for i in range(4)
+        ]
+        bridge.observe_nodes(nodes)
+        # running pods parked AWAY from all their data: migration wins
+        pods = [
+            Task(uid=f"run-{i}", cpu_request=0.1,
+                 memory_request_kb=64, phase=TaskPhase.RUNNING,
+                 machine=f"m{3 - i % 2}",
+                 data_prefs={f"m{i % 2}": 500})
+            for i in range(3)
+        ]
+        bridge.observe_pods(pods)
+        res = bridge.run_scheduler()
+        assert res.migrations, "expected rebalancing migrations"
+        rec = fr.last_round_record()
+        ex = RoundExplainer.from_record(rec)
+        seen = 0
+        for rnd, kind, uid, detail in bridge.decision_log:
+            if kind != "MIGRATE" or rnd != rec.round_num:
+                continue
+            assert detail["cost"] is not None
+            e = ex.explain(uid)
+            assert e.kind == "MIGRATE"
+            assert e.cost == detail["cost"]
+            assert sum(e.terms.values()) == e.cost
+            seen += 1
+        assert seen == len(res.migrations)
+
+
+class TestUnscheduledDiagnosis:
+    def test_priced_out_validates(self):
+        """quincy parks pods whose data is nowhere local; diagnosis is
+        priced-out and the minimal unsched-cost slack places them on
+        re-solve."""
+        bridge, fr = _session("quincy", rounds=1)
+        rec = fr.last_round_record()
+        ex = RoundExplainer.from_record(rec)
+        unsched = rec.result["unscheduled"]
+        assert unsched, "scenario must park some pods"
+        for uid in unsched:
+            e = ex.explain(uid)
+            assert e.kind == "UNSCHEDULED"
+            assert e.diagnosis == "priced-out", (uid, e.diagnosis)
+            assert sum(e.terms.values()) == e.cost
+            v = ex.validate(e)
+            assert v["ok"], (uid, e.relaxation, v)
+
+    def test_capacity_exhausted_validates(self):
+        """octopus places whenever seats exist (unsched base 2500);
+        oversubscribe the seats and the parked pods diagnose as
+        capacity-exhausted, placed by adding seats."""
+        fr = FlightRecorder("unused", rounds=2)
+        bridge = SchedulerBridge(
+            cost_model="octopus", small_to_oracle=False, flightrec=fr,
+            max_tasks_per_machine=3,
+        )
+        nodes = [
+            Machine(name=f"m{i}", cpu_capacity=8.0,
+                    cpu_allocatable=8.0,
+                    memory_capacity_kb=1 << 20,
+                    memory_allocatable_kb=1 << 20,
+                    max_tasks=3, rack="r0")
+            for i in range(2)
+        ]
+        bridge.observe_nodes(nodes)
+        bridge.observe_pods([
+            Task(uid=f"p{i}", cpu_request=0.1, memory_request_kb=64)
+            for i in range(9)
+        ])
+        res = bridge.run_scheduler()
+        assert res.unscheduled, "6 seats, 9 pods: some must park"
+        rec = fr.last_round_record()
+        ex = RoundExplainer.from_record(rec)
+        for uid in res.unscheduled:
+            e = ex.explain(uid)
+            assert e.diagnosis == "capacity-exhausted", (uid, e)
+            v = ex.validate(e)
+            assert v["ok"], (uid, e.relaxation, v)
+
+    def test_pref_pruned_validates(self):
+        """--topk_prefs drops the pref that would have placed the pod
+        (its heavier pref targets a full machine): diagnosis is
+        pref-pruned with the minimal pref rank, and restoring the
+        prefs places it."""
+        fr = FlightRecorder("unused", rounds=2)
+        bridge = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False, flightrec=fr,
+            topk_prefs=1, max_tasks_per_machine=1,
+        )
+        nodes = [
+            Machine(name=n, cpu_capacity=8.0, cpu_allocatable=8.0,
+                    memory_capacity_kb=1 << 20,
+                    memory_allocatable_kb=1 << 20,
+                    max_tasks=1, rack="r0")
+            for n in ("mA", "mB")
+        ]
+        bridge.observe_nodes(nodes)
+        # mA is full (running pod occupies its only seat)
+        bridge.observe_pods([
+            Task(uid="occupant", cpu_request=0.1,
+                 memory_request_kb=64, phase=TaskPhase.RUNNING,
+                 machine="mA"),
+            # wA=45 > wB=30: top-1 keeps the mA pref. Pruned routes:
+            # mA pref remote=30 (<u=50) but mA has no seat; mB via
+            # cluster = 75+10 > 50 -> parked. Full topo: mB pref
+            # remote=45 < 50 with a free seat -> pref-pruned.
+            Task(uid="victim", cpu_request=0.1, memory_request_kb=64,
+                 data_prefs={"mA": 45, "mB": 30}),
+        ])
+        res = bridge.run_scheduler()
+        assert "victim" in res.unscheduled, res.stats
+        rec = fr.last_round_record()
+        ex = RoundExplainer.from_record(rec)
+        e = ex.explain("victim")
+        assert e.diagnosis == "pref-pruned", e
+        assert e.relaxation["topk_prefs"] == 2
+        v = ex.validate(e)
+        assert v["ok"] and v["placed_on"] == "mB", v
+
+    def test_churn_budget_deferred_validates(self):
+        """A migration the per-round budget dropped diagnoses as
+        churn-budget-deferred; granting the stated budget actuates
+        it in the delta extractor."""
+        fr = FlightRecorder("unused", rounds=2)
+        bridge = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False, flightrec=fr,
+            enable_preemption=True, migration_hysteresis=1,
+            max_migrations_per_round=1,
+        )
+        nodes = [
+            Machine(name=f"m{i}", cpu_capacity=16.0,
+                    cpu_allocatable=16.0,
+                    memory_capacity_kb=1 << 20,
+                    memory_allocatable_kb=1 << 20,
+                    max_tasks=4, rack="r0")
+            for i in range(4)
+        ]
+        bridge.observe_nodes(nodes)
+        bridge.observe_pods([
+            Task(uid=f"run-{i}", cpu_request=0.1,
+                 memory_request_kb=64, phase=TaskPhase.RUNNING,
+                 machine=f"m{2 + i % 2}",
+                 data_prefs={f"m{i % 2}": 500})
+            for i in range(3)
+        ])
+        res = bridge.run_scheduler()
+        rec = fr.last_round_record()
+        deferred = rec.result["deferred"]
+        assert deferred, (res.migrations, res.stats)
+        ex = RoundExplainer.from_record(rec)
+        for uid in deferred:
+            e = ex.explain(uid)
+            assert e.diagnosis == "churn-budget-deferred", e
+            v = ex.validate(e)
+            assert v["ok"], (uid, e.relaxation, v)
+
+
+class TestExplainerSurface:
+    def test_render_transcript(self):
+        bridge, fr = _session("quincy", rounds=1)
+        rec = fr.last_round_record()
+        ex = RoundExplainer.from_record(rec)
+        placed = [
+            uid for rnd, kind, uid, d in bridge.decision_log
+            if kind == "PLACE" and rnd == rec.round_num
+        ]
+        text = render_explanation(ex.explain(placed[0]))
+        assert "sums exactly" in text
+        assert "runner-up" in text
+        un = rec.result["unscheduled"]
+        text_u = render_explanation(ex.explain(un[0]))
+        assert "diagnosis: priced-out" in text_u
+        assert "minimal relaxation" in text_u
+
+    def test_unknown_uid_raises(self):
+        bridge, fr = _session("trivial", rounds=1, pods=8)
+        ex = RoundExplainer.from_record(fr.last_round_record())
+        with pytest.raises(ExplainError):
+            ex.explain("no-such-pod")
+
+    def test_from_record_requires_result(self):
+        with pytest.raises(ExplainError):
+            RoundExplainer.from_record(None)
+
+    def test_oracle_path_costs_match_dense(self):
+        """The decision log's costs on the oracle routing path (host-
+        computed) agree with the explainer — same instance, same
+        numbers as the dense path produces."""
+        fr = FlightRecorder("unused", rounds=2)
+        bridge = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=True, flightrec=fr,
+        )
+        cluster = make_synthetic_cluster(
+            6, 30, seed=5, prefs_per_task=2
+        )
+        bridge.observe_nodes(list(cluster.machines))
+        bridge.observe_pods(list(cluster.tasks))
+        res = bridge.run_scheduler()
+        assert res.stats.backend == "oracle:small-instance"
+        rec = fr.last_round_record()
+        ex = RoundExplainer.from_record(rec)
+        n = 0
+        for rnd, kind, uid, detail in bridge.decision_log:
+            if rnd != rec.round_num or detail.get("cost") is None:
+                continue
+            assert ex.explain(uid).cost == detail["cost"], uid
+            n += 1
+        assert n > 0
+
+    def test_margin_negative_when_capacity_forces(self):
+        """A pod squeezed onto a worse machine because its best one
+        filled up reports a NEGATIVE margin (runner-up cheaper than
+        chosen) — the honest signal, not clamped to zero."""
+        fr = FlightRecorder("unused", rounds=2)
+        bridge = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False, flightrec=fr,
+            max_tasks_per_machine=1,
+        )
+        nodes = [
+            Machine(name=n, cpu_capacity=8.0, cpu_allocatable=8.0,
+                    memory_capacity_kb=1 << 20,
+                    memory_allocatable_kb=1 << 20,
+                    max_tasks=1, rack="r0")
+            for n in ("good", "meh")
+        ]
+        bridge.observe_nodes(nodes)
+        # quincy remote-data = total - weight: weights 49/48 price the
+        # good route at 48 and the meh route at 49, both under the
+        # unsched cost 50 — so both pods want "good", the seats force
+        # one onto "meh", and its runner-up (good, 48) is CHEAPER
+        # than its chosen route (49)
+        bridge.observe_pods([
+            Task(uid=f"p{i}", cpu_request=0.1, memory_request_kb=64,
+                 data_prefs={"good": 49, "meh": 48})
+            for i in range(2)
+        ])
+        res = bridge.run_scheduler()
+        assert sorted(res.bindings.values()) == ["good", "meh"]
+        rec = fr.last_round_record()
+        ex = RoundExplainer.from_record(rec)
+        squeezed = next(
+            u for u, m in res.bindings.items() if m == "meh"
+        )
+        e = ex.explain(squeezed)
+        assert e.margin is not None and e.margin < 0, e

@@ -1,0 +1,309 @@
+"""Flight recorder: bounded ring, dump/load round-trip, anomaly
+triggers (express degrade, fetch timeout, resync storm), and the
+recorder's zero-interference contract."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.cluster import Task
+from poseidon_tpu.guards import FetchTimeout
+from poseidon_tpu.obs.flightrec import (
+    DUMP_REASONS,
+    FlightRecorder,
+    load_dump,
+)
+from poseidon_tpu.obs.metrics import (
+    MetricsRegistry,
+    STORM_RESYNCS,
+    SchedulerMetrics,
+)
+from poseidon_tpu.synth import make_synthetic_cluster
+
+
+def _churn_session(tmp_path, *, rounds=3, churn=3, recorder=True,
+                   **bridge_kw):
+    fr = (
+        FlightRecorder(str(tmp_path / "fr"), rounds=4)
+        if recorder else None
+    )
+    bridge = SchedulerBridge(
+        cost_model="quincy", small_to_oracle=False, flightrec=fr,
+        **bridge_kw,
+    )
+    cluster = make_synthetic_cluster(10, 40, seed=0, prefs_per_task=2)
+    bridge.observe_nodes(list(cluster.machines))
+    bridge.observe_pods(list(cluster.tasks))
+    res = bridge.run_scheduler()
+    results = [res]
+    running = []
+    for uid, m in res.bindings.items():
+        bridge.confirm_binding(uid, m)
+        running.append(uid)
+    seq = 0
+    for _ in range(rounds - 1):
+        for _ in range(churn):
+            done = running.pop(0)
+            freed = bridge.pod_to_machine[done]
+            bridge.observe_pod_event("DELETED", bridge.tasks[done])
+            bridge.observe_pod_event("ADDED", Task(
+                uid=f"x-{seq}", cpu_request=0.1,
+                memory_request_kb=128, data_prefs={freed: 400},
+            ))
+            seq += 1
+        r = bridge.run_scheduler()
+        results.append(r)
+        for uid, m in r.bindings.items():
+            bridge.confirm_binding(uid, m)
+            if uid.startswith("x-"):
+                running.append(uid)
+    return bridge, fr, results
+
+
+class TestRing:
+    def test_ring_is_bounded_by_rounds(self, tmp_path):
+        bridge, fr, _ = _churn_session(tmp_path, rounds=7)
+        rounds = [r for r in fr.records if r.kind == "round"]
+        assert len(rounds) == 4  # the recorder's K
+        assert rounds[-1].round_num == 7
+        assert rounds[0].round_num == 4  # oldest three dropped
+
+    def test_capture_copies_not_references(self, tmp_path):
+        """The incremental builder patches its columns in place across
+        rounds — retained references would mutate under the ring.
+        Captured arrays must be stable across later rounds."""
+        bridge, fr, _ = _churn_session(tmp_path, rounds=2)
+        rec = [r for r in fr.records if r.kind == "round"][0]
+        snap = {k: v.copy() for k, v in rec.arrays.items()}
+        wait_snap = rec.meta.task_wait.copy()
+        # churn two more rounds through the same bridge
+        for _ in range(2):
+            bridge.observe_pod_event("ADDED", Task(
+                uid=f"later-{_}", cpu_request=0.1,
+                memory_request_kb=64,
+            ))
+            r = bridge.run_scheduler()
+            for uid, m in r.bindings.items():
+                bridge.confirm_binding(uid, m)
+        for k, v in snap.items():
+            assert np.array_equal(rec.arrays[k], v), k
+        assert np.array_equal(rec.meta.task_wait, wait_snap)
+
+    def test_result_attached_at_finish(self, tmp_path):
+        _, fr, results = _churn_session(tmp_path, rounds=2)
+        for rec in fr.records:
+            if rec.kind != "round":
+                continue
+            assert rec.result is not None
+            assert rec.result["backend"] == "dense_auction"
+            assert "unscheduled" in rec.result
+            assert rec.stats["round_num"] == rec.round_num
+
+
+class TestDump:
+    def test_dump_roundtrip(self, tmp_path):
+        bridge, fr, _ = _churn_session(tmp_path, rounds=3)
+        path = bridge.flight_dump("manual", label="test")
+        assert path and os.path.exists(path)
+        assert os.path.exists(path.replace(".json", ".npz"))
+        manifest = json.load(open(path))
+        assert manifest["reason"] == "manual"
+        assert manifest["label"] == "test"
+        dump = load_dump(path)
+        got = [r for r in dump["records"] if r.kind == "round"]
+        want = [r for r in fr.records if r.kind == "round"]
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert g.round_num == w.round_num
+            assert g.cost_model == w.cost_model
+            assert g.flags == w.flags
+            assert g.pad_floors == w.pad_floors
+            for k in w.arrays:
+                assert np.array_equal(g.arrays[k], w.arrays[k]), k
+            assert g.meta.task_uids == w.meta.task_uids
+            assert np.array_equal(
+                g.result["assignment"], w.result["assignment"]
+            )
+            assert g.result["cost"] == w.result["cost"]
+            if w.warm_seed is not None:
+                for a, b in zip(g.warm_seed, w.warm_seed):
+                    assert np.array_equal(a, b)
+
+    def test_dump_emits_trace_event_and_metric(self, tmp_path):
+        metrics = SchedulerMetrics(MetricsRegistry())
+        fr = FlightRecorder(
+            str(tmp_path / "fr"), rounds=2, metrics=metrics
+        )
+        bridge = SchedulerBridge(
+            cost_model="trivial", small_to_oracle=False, flightrec=fr,
+        )
+        cluster = make_synthetic_cluster(8, 20, seed=1)
+        bridge.observe_nodes(list(cluster.machines))
+        bridge.observe_pods(list(cluster.tasks))
+        bridge.run_scheduler()
+        path = bridge.flight_dump("manual")
+        assert path is not None
+        evs = [
+            e for e in bridge.trace.events
+            if e.event == "FLIGHTREC_DUMP"
+        ]
+        assert len(evs) == 1
+        assert evs[0].detail["reason"] == "manual"
+        assert evs[0].detail["path"] == path
+        text = metrics.registry.render()
+        assert (
+            'poseidon_flightrec_dumps_total{reason="manual"} 1'
+            in text
+        )
+
+    def test_empty_ring_dump_is_none(self, tmp_path):
+        bridge = SchedulerBridge(
+            cost_model="trivial",
+            flightrec=FlightRecorder(str(tmp_path / "fr")),
+        )
+        assert bridge.flight_dump("manual") is None
+
+    def test_undeclared_reason_raises(self, tmp_path):
+        fr = FlightRecorder(str(tmp_path / "fr"))
+        with pytest.raises(ValueError):
+            fr.dump("because")
+        assert "manual" in DUMP_REASONS
+
+    def test_anomaly_dumps_are_cooldown_throttled(self, tmp_path):
+        """A persistently-anomalous daemon (degrading every round)
+        writes ONE dump per reason per cooldown window, not one per
+        round; manual dumps are never throttled."""
+        bridge, fr, _ = _churn_session(tmp_path, rounds=2)
+        assert fr.dump("degrade") is not None
+        assert fr.dump("degrade") is None  # within cooldown
+        assert fr.dumps_suppressed == 1
+        assert fr.dump("fetch-timeout") is not None  # other reason
+        assert fr.dump("manual") is not None
+        assert fr.dump("manual") is not None
+        fr._last_dump["degrade"] -= fr.cooldown_s + 1
+        assert fr.dump("degrade") is not None  # window elapsed
+
+    def test_dump_stem_is_boot_unique(self, tmp_path):
+        """A restarted daemon's round numbers and sequence counter
+        reset; the boot token keeps it from overwriting the previous
+        boot's evidence."""
+        bridge, fr, _ = _churn_session(tmp_path, rounds=2)
+        path = bridge.flight_dump("manual")
+        assert f"flightrec-{fr._boot}-r" in os.path.basename(path)
+
+    def test_watch_rv_stamped_into_records(self, tmp_path):
+        """The driver stamps the watcher's applied resourceVersion
+        onto each round's record (bridge.flight_rv), so a dump
+        correlates with the apiserver's event history."""
+        bridge, fr, _ = _churn_session(tmp_path, rounds=1)
+        bridge.flight_rv = "nodes=17,pods=42"
+        bridge.observe_pod_event("ADDED", Task(
+            uid="rv-pod", cpu_request=0.1, memory_request_kb=64,
+        ))
+        bridge.run_scheduler()
+        rec = fr.last_round_record()
+        assert rec.rv == "nodes=17,pods=42"
+        path = bridge.flight_dump("manual")
+        loaded = load_dump(path)
+        last = [r for r in loaded["records"] if r.kind == "round"][-1]
+        assert last.rv == "nodes=17,pods=42"
+
+
+class TestAnomalyTriggers:
+    def test_express_degrade_dumps(self, tmp_path):
+        fr = FlightRecorder(str(tmp_path / "fr"), rounds=4)
+        bridge = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False, flightrec=fr,
+            express_lane=True, express_max_batch=1,
+        )
+        cluster = make_synthetic_cluster(
+            10, 30, seed=2, prefs_per_task=2
+        )
+        bridge.observe_nodes(list(cluster.machines))
+        bridge.observe_pods(list(cluster.tasks))
+        res = bridge.run_scheduler()
+        for uid, m in res.bindings.items():
+            bridge.confirm_binding(uid, m)
+        assert bridge.solver.express_ready
+        # 2 arrivals > --express_max_batch=1: the batch degrades
+        events = [
+            ("ADDED", Task(uid=f"burst-{k}", cpu_request=0.1,
+                           memory_request_kb=64))
+            for k in range(2)
+        ]
+        out = bridge.express_batch(events)
+        assert out is None
+        dumps = [
+            f for f in os.listdir(tmp_path / "fr")
+            if "express-degrade" in f and f.endswith(".json")
+        ]
+        assert len(dumps) == 1
+        # the degraded batch's inputs are IN the dump
+        dump = load_dump(str(tmp_path / "fr" / dumps[0]))
+        ex = [r for r in dump["records"] if r.kind == "express"]
+        assert ex and not ex[-1].result["ok"]
+        assert {a["uid"] for a in ex[-1].arrivals} == {
+            "burst-0", "burst-1"
+        }
+
+    def test_fetch_timeout_dumps(self, tmp_path, monkeypatch):
+        bridge, fr, _ = _churn_session(tmp_path, rounds=2)
+
+        def boom(_):
+            raise FetchTimeout("synthetic deadline miss")
+
+        monkeypatch.setattr(bridge.solver, "finish_round", boom)
+        ir = bridge.begin_round()
+        with pytest.raises(FetchTimeout):
+            bridge.finish_round(ir)
+        dumps = [
+            f for f in os.listdir(tmp_path / "fr")
+            if "fetch-timeout" in f and f.endswith(".json")
+        ]
+        assert len(dumps) == 1
+        # the abandoned round's inputs are the LAST record, resultless
+        dump = load_dump(str(tmp_path / "fr" / dumps[0]))
+        last = dump["records"][-1]
+        assert last.kind == "round" and last.result is None
+
+    def test_resync_storm_dumps_once(self, tmp_path):
+        bridge, fr, _ = _churn_session(tmp_path, rounds=2)
+        for _ in range(3):
+            bridge.note_watch_activity(resyncs=STORM_RESYNCS)
+            r = bridge.run_scheduler()
+        dumps = [
+            f for f in os.listdir(tmp_path / "fr")
+            if "resync-storm" in f and f.endswith(".json")
+        ]
+        assert len(dumps) == 1  # latched: a persisting storm != spam
+
+
+class TestZeroInterference:
+    def test_recorder_does_not_change_decisions(self, tmp_path):
+        """Same session with and without the recorder: identical
+        bindings, costs, and backends every round."""
+        _, _, with_fr = _churn_session(
+            tmp_path, rounds=3, recorder=True
+        )
+        _, _, without = _churn_session(
+            tmp_path, rounds=3, recorder=False
+        )
+        for a, b in zip(with_fr, without):
+            assert a.bindings == b.bindings
+            assert a.stats.cost == b.stats.cost
+            assert a.stats.backend == b.stats.backend
+
+    def test_decision_log_detail_is_typed(self, tmp_path):
+        bridge, _, _ = _churn_session(tmp_path, rounds=2)
+        places = [
+            d for _r, kind, _u, d in bridge.decision_log
+            if kind == "PLACE"
+        ]
+        assert places
+        for d in places:
+            assert isinstance(d, dict)
+            assert isinstance(d["cost"], int)
+            assert d["margin"] is None or isinstance(d["margin"], int)

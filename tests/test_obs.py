@@ -226,6 +226,43 @@ class TestServer:
         assert health.ready
         assert "poseidon_ready 1" in reg.render()
 
+    def test_build_info_gauge_and_healthz_echo(self):
+        """poseidon_build_info scrapes with the deploy-identity labels
+        and /healthz echoes the same dict as JSON."""
+        import jax
+
+        from poseidon_tpu.obs import build_info
+
+        import poseidon_tpu
+
+        reg = MetricsRegistry()
+        metrics = SchedulerMetrics(reg)
+        info = build_info(mesh_width=4)
+        assert info["version"] == poseidon_tpu.__version__
+        assert info["jax"] == jax.__version__
+        metrics.set_build_info(info)
+        text = reg.render()
+        assert "# TYPE poseidon_build_info gauge" in text
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("poseidon_build_info{")
+        )
+        assert f'version="{poseidon_tpu.__version__}"' in line
+        assert 'mesh_width="4"' in line
+        assert line.endswith(" 1")
+        with ObsServer(reg, HealthState(), port=0, host="127.0.0.1",
+                       build=info) as srv:
+            code, body = _get(srv.port, "/healthz")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["status"] == "ok"
+            assert doc["build"]["jax"] == jax.__version__
+            assert doc["build"]["backend"] == info["backend"]
+            # /metrics carries the family alongside
+            assert "poseidon_build_info" in _get(
+                srv.port, "/metrics"
+            )[1]
+
     def test_scrape_concurrent_with_recording(self):
         reg = MetricsRegistry()
         c = reg.counter("poseidon_rounds_total")
@@ -451,6 +488,64 @@ class TestReportAndChrome:
         assert tree["dur_ms"] == pytest.approx(
             1.0 + 2.0 + 0.5 + 4.0 + 2.0 + 0.25
         )
+
+
+class TestTenantReport:
+    def _two_tenant_trace(self, tmp_path):
+        """A fake-serve-shaped trace: two tenant sessions writing into
+        ONE sink, each generator stamped with its tenant id (exactly
+        what service.add_tenant does)."""
+        path = tmp_path / "serve.jsonl"
+        with open(path, "w") as fh:
+            for tid, n_rounds, total in (
+                ("tenant-0", 3, 5.0), ("tenant-1", 2, 50.0),
+            ):
+                gen = TraceGenerator(sink=fh, tenant=tid)
+                for r in range(1, n_rounds + 1):
+                    gen.emit(
+                        "SCHEDULE", task=f"{tid}-pod-{r}",
+                        machine=f"{tid}-n0", round_num=r,
+                    )
+                    gen.emit("ROUND", round_num=r, detail={
+                        "backend": "dense_auction",
+                        "lane": "service", "build_mode": "delta",
+                        "total_ms": total,
+                    })
+                gen.flush()
+        return str(path)
+
+    def test_service_sessions_stamp_tenant(self):
+        from poseidon_tpu.service.service import SchedulingService
+
+        svc = SchedulingService()
+        s = svc.add_tenant("acme")
+        assert s.trace.tenant == "acme"
+        s.bridge.trace.emit("ROUND", round_num=1)
+        assert s.trace.events[-1].tenant == "acme"
+
+    def test_tenant_filter_isolates_sessions(self, tmp_path):
+        path = self._two_tenant_trace(tmp_path)
+        whole = analyze_trace(path)
+        t0 = analyze_trace(path, tenant="tenant-0")
+        t1 = analyze_trace(path, tenant="tenant-1")
+        assert whole["rounds"] == 5
+        assert t0["rounds"] == 3 and t1["rounds"] == 2
+        assert t0["churn"]["totals"]["SCHEDULE"] == 3
+        assert t1["churn"]["totals"]["SCHEDULE"] == 2
+        # latency percentiles come from ONLY the tenant's own rounds
+        assert t0["round_latency_ms"]["service/delta"]["p50"] == 5.0
+        assert t1["round_latency_ms"]["service/delta"]["p50"] == 50.0
+        assert analyze_trace(path, tenant="ghost")["rounds"] == 0
+
+    def test_report_cli_tenant_flag(self, tmp_path, capsys):
+        from poseidon_tpu.trace import main as trace_main
+
+        path = self._two_tenant_trace(tmp_path)
+        rc = trace_main(["report", path, "--tenant", "tenant-1"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "tenant: tenant-1" in out
+        assert "rounds: 2" in out
 
 
 class TestZeroRecompileUnderDrain:

@@ -62,9 +62,53 @@ class TestReadTrace:
         assert len(events) == 1
         assert events[0].task == "p"
         assert events[0].detail == {"k": 1}
-        assert not hasattr(events[0], "tenant")
+        # "tenant" graduated from future-field to known schema (the
+        # service lane stamps it); only the still-unknown key drops
+        assert events[0].tenant == "acme"
         warning = "\n".join(caplog.messages)
-        assert "shard" in warning and "tenant" in warning
+        assert "shard" in warning and "tenant" not in warning
+
+    def test_truncated_final_line_warns_and_drops(
+        self, tmp_path, caplog
+    ):
+        """A crash mid-write (flight-recorder territory: OOM-kill,
+        device wedge) tears the FINAL line; the reader drops it with
+        one warning instead of raising — torn tails are a normal
+        post-mortem artifact."""
+        path = tmp_path / "torn.jsonl"
+        ev = {"timestamp_us": 1, "event": "SUBMIT", "task": "p",
+              "machine": "", "round_num": 1, "detail": None}
+        full = json.dumps(ev)
+        path.write_text(full + "\n" + full + "\n" + full[: 17])
+        with caplog.at_level("WARNING", logger="poseidon_tpu.trace"):
+            events = list(read_trace(str(path)))
+        assert len(events) == 2
+        assert any(
+            "truncated final line" in m for m in caplog.messages
+        )
+
+    def test_truncated_final_line_after_trailing_blank(
+        self, tmp_path, caplog
+    ):
+        path = tmp_path / "torn2.jsonl"
+        ev = json.dumps({"timestamp_us": 1, "event": "SUBMIT",
+                         "task": "p", "machine": "", "round_num": 1,
+                         "detail": None})
+        path.write_text(ev + "\n" + ev[:9] + "\n\n")
+        with caplog.at_level("WARNING", logger="poseidon_tpu.trace"):
+            events = list(read_trace(str(path)))
+        assert len(events) == 1
+
+    def test_mid_file_corruption_still_raises(self, tmp_path):
+        """Only the torn TAIL is forgiven; garbage mid-file is real
+        corruption and must stay loud."""
+        path = tmp_path / "corrupt.jsonl"
+        ev = json.dumps({"timestamp_us": 1, "event": "SUBMIT",
+                         "task": "p", "machine": "", "round_num": 1,
+                         "detail": None})
+        path.write_text(ev + "\n{broken\n" + ev + "\n")
+        with pytest.raises(json.JSONDecodeError):
+            list(read_trace(str(path)))
 
     def test_forward_compat_no_warning_on_clean_file(
         self, tmp_path, caplog

@@ -67,6 +67,10 @@ _OBS_HOT_SCOPES = {
         "SchedulerMetrics.record_service_round",
         "SchedulerMetrics.record_service_dispatch",
         "SchedulerMetrics.record_service_compiles",
+        "SchedulerMetrics.record_checkpoint",
+        "SchedulerMetrics.record_checkpoint_age",
+        "SchedulerMetrics.record_journal_replay",
+        "SchedulerMetrics.record_restore",
     ),
     "poseidon_tpu/obs/spans.py": (
         "round_span_tree",
@@ -209,6 +213,16 @@ DEFAULT_CONTRACTS = Contracts(
             "SchedulingService._finish_wave",
             "SchedulingService._account",
         ),
+        # the checkpoint capture path (ha/checkpoint.py) runs on the
+        # driver thread right after a round: shallow dict copies +
+        # host-array copies only, never a device sync (the warm seed
+        # is the mirror the round's own fetch already downloaded); it
+        # is deliberately NOT an O(churn) scope — the amortized-
+        # cadence O(cluster) dict copy is its documented design
+        "poseidon_tpu/ha/checkpoint.py": (
+            "capture_snapshot",
+            "CheckpointManager.capture",
+        ),
         # observability recording + span assembly (_OBS_HOT_SCOPES):
         # pure host arithmetic on values the caller already fetched,
         # never a new device sync
@@ -334,6 +348,21 @@ DEFAULT_CONTRACTS = Contracts(
         # attribute — the serving thread holds the httpd OBJECT via
         # Thread(target=), it never dereferences ``self._httpd``)
         "ObsServer": ThreadContract(lock_attr="_lock", handoffs={}),
+        # the checkpoint manager (ha/checkpoint.py): capture on the
+        # driver thread, serialization on the background writer; the
+        # snapshot handoff is a queue.Queue of immutable-after-capture
+        # snapshots (frozen dataclasses + copy-on-write arrays), and
+        # the writer statistics are read/written under _lock on both
+        # sides
+        "CheckpointManager": ThreadContract(
+            lock_attr="_lock", handoffs={}
+        ),
+        # the actuation journal (ha/journal.py): intents/terminal
+        # marks from the driver thread, ``posted`` marks from the
+        # bounded binding-POST pool — every file write holds _lock
+        "ActuationJournal": ThreadContract(
+            lock_attr="_lock", handoffs={}
+        ),
         # watch.py's per-resource reader thread (the former ``rv``
         # handoff entry was PTA006-audited stale: the reconnect cursor
         # is reader-thread-private — construction aside, no main-thread

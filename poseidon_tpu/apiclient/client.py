@@ -56,7 +56,18 @@ RACK_LABELS = (
 
 
 class ApiError(RuntimeError):
-    """The apiserver could not be reached or answered garbage."""
+    """The apiserver could not be reached or answered garbage.
+
+    ``code`` carries the HTTP status when one was received (0 for
+    transport-level failures), so callers can branch on protocol
+    answers — 404 pod-gone in ``get_pod``, 409 binding-conflict in
+    ``bind_pod_to_node``, 409 lease-held in ``acquire_lease`` —
+    without parsing the message string.
+    """
+
+    def __init__(self, msg: str, code: int = 0):
+        super().__init__(msg)
+        self.code = code
 
 
 def backoff_delay(
@@ -136,7 +147,10 @@ class K8sApiClient:
 
     # ---- transport -----------------------------------------------------
 
-    def _request(self, path: str, body: dict | None = None) -> dict:
+    def _request(
+        self, path: str, body: dict | None = None,
+        method: str | None = None,
+    ) -> dict:
         url = f"{self.base}/{path}"
         data = None
         headers = {}
@@ -148,7 +162,7 @@ class K8sApiClient:
             retry_after = ""
             try:
                 req = urllib.request.Request(
-                    url, data=data, headers=headers
+                    url, data=data, headers=headers, method=method
                 )
                 with urllib.request.urlopen(
                     req, timeout=self.timeout_s
@@ -161,7 +175,9 @@ class K8sApiClient:
                 # attempt on an answer that will not change. Only 429
                 # (throttled) and 5xx (server-side trouble) can heal.
                 if e.code != 429 and e.code < 500:
-                    raise ApiError(f"{url}: HTTP {e.code}") from e
+                    raise ApiError(
+                        f"{url}: HTTP {e.code}", code=e.code
+                    ) from e
                 if e.code == 429:
                     retry_after = e.headers.get("Retry-After", "")
                 last = e
@@ -188,7 +204,11 @@ class K8sApiClient:
                     except ValueError:
                         pass  # HTTP-date form: keep the jittered delay
                 time.sleep(delay)
-        raise ApiError(f"{url}: {last}") from last
+        raise ApiError(
+            f"{url}: {last}",
+            code=last.code
+            if isinstance(last, urllib.error.HTTPError) else 0,
+        ) from last
 
     def _list(self, resource: str, selector: str = "") -> list[dict]:
         return self._list_rv(resource, selector)[0]
@@ -360,6 +380,29 @@ class K8sApiClient:
             data_prefs=prefs,
         )
 
+    def get_pod(
+        self, pod: str, namespace: str = "default"
+    ) -> Task | None:
+        """One pod's current state, or None when it no longer exists.
+
+        The idempotency primitive: the binding-conflict check and the
+        actuation-journal replay (ha/journal.py) both decide "has this
+        op's effect already landed" from the answer. ``pod`` accepts
+        the same bare-or-qualified forms as ``bind_pod_to_node``.
+        """
+        if "/" in pod:
+            namespace, pod = pod.split("/", 1)
+        try:
+            doc = self._request(f"namespaces/{namespace}/pods/{pod}")
+        except ApiError as e:
+            if e.code == 404:
+                return None
+            raise
+        try:
+            return self._parse_pod(doc)
+        except (KeyError, ValueError) as e:
+            raise ApiError(f"unparseable pod {namespace}/{pod}: {e}")
+
     # ---- bindings ------------------------------------------------------
 
     def bind_pod_to_node(
@@ -386,6 +429,24 @@ class K8sApiClient:
             self._request(f"namespaces/{namespace}/bindings", body)
             return True
         except ApiError as e:
+            if e.code == 409:
+                # Conflict: a binding already exists. When it targets
+                # the SAME node this POST is a duplicate of an op that
+                # already landed (a retried request, a journal replay
+                # after a crash, a restarted daemon re-actuating) —
+                # that is SUCCESS, not a failure: counting it as failed
+                # would inflate bind_failures and age/re-queue a pod
+                # the apiserver already placed exactly where we asked.
+                try:
+                    cur = self.get_pod(pod, namespace=namespace)
+                except ApiError:
+                    cur = None
+                if cur is not None and cur.machine == node:
+                    log.info(
+                        "binding %s -> %s already exists; counting "
+                        "the duplicate POST as success", pod, node,
+                    )
+                    return True
             log.error("binding %s -> %s failed: %s", pod, node, e)
             return False
 
@@ -415,3 +476,61 @@ class K8sApiClient:
         except ApiError as e:
             log.error("eviction of %s failed: %s", pod, e)
             return False
+
+    # ---- leases (HA leader election, ha/standby.py) --------------------
+
+    def acquire_lease(
+        self,
+        name: str,
+        identity: str,
+        duration_s: float,
+        namespace: str = "kube-system",
+    ) -> bool:
+        """PUT the Lease; True = granted (free, expired, or already
+        ours — an acquire doubles as a renew). False = held by someone
+        else (HTTP 409). Transport failures raise like any request."""
+        body = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "holderIdentity": identity,
+                "leaseDurationSeconds": duration_s,
+            },
+        }
+        try:
+            self._request(
+                f"namespaces/{namespace}/leases/{name}", body,
+                method="PUT",
+            )
+            return True
+        except ApiError as e:
+            if e.code == 409:
+                return False
+            raise
+
+    def read_lease(
+        self, name: str, namespace: str = "kube-system"
+    ) -> dict | None:
+        try:
+            return self._request(f"namespaces/{namespace}/leases/{name}")
+        except ApiError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def release_lease(
+        self, name: str, identity: str,
+        namespace: str = "kube-system",
+    ) -> None:
+        """DELETE the Lease (clean step-down); a 404/409 (already gone
+        / stolen) is not an error worth failing shutdown over."""
+        try:
+            self._request(
+                f"namespaces/{namespace}/leases/{name}"
+                f"?holderIdentity={urllib.parse.quote(identity)}",
+                method="DELETE",
+            )
+        except ApiError as e:
+            if e.code not in (404, 409):
+                raise

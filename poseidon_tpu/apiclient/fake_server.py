@@ -51,6 +51,7 @@ from __future__ import annotations
 import bisect
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -71,8 +72,16 @@ class FakeApiServer:
         self._rate_limit_next = 0
         self._rate_limit_retry_after = 0.05
         self._disconnect_next = 0
+        # crash-consistency injection (ha/ tests): the next n mutation
+        # POSTs are APPLIED and then the connection dies without a
+        # response — the "op landed but the caller never learned"
+        # world a process crash between POST and ack produces
+        self._apply_then_disconnect_next = 0
         self._truncate = 0
         self.requests_served = 0
+        # Lease objects (leader election): key "ns/name" ->
+        # {holder, duration_s, renew_unix, transitions}
+        self.leases: dict[str, dict] = {}
         # ---- watch protocol state ----
         # monotonic resourceVersion; every mutation appends one
         # (rv, kind, type, object-copy) record to the event log
@@ -175,6 +184,7 @@ class FakeApiServer:
                     return
                 with server._lock:
                     selector = query.get("labelSelector", [""])[0]
+                    parts = url.path.strip("/").split("/")
                     if url.path == "/api/v1/nodes":
                         items = server._select(
                             server.nodes.values(), selector
@@ -186,6 +196,41 @@ class FakeApiServer:
                             server.pods.values(), selector
                         )
                         self._reply(200, server._page(items, query))
+                    # api/v1/namespaces/{ns}/pods/{name}: the single-
+                    # pod read the binding-conflict check and the
+                    # actuation-journal replay decide idempotency from
+                    elif (
+                        len(parts) == 6
+                        and parts[2] == "namespaces"
+                        and parts[4] == "pods"
+                    ):
+                        server._apply_pending()
+                        doc = server.pods.get(f"{parts[3]}/{parts[5]}")
+                        if doc is None:
+                            self._reply(
+                                404,
+                                {"error": f"no pod "
+                                          f"{parts[3]}/{parts[5]}"},
+                            )
+                        else:
+                            self._reply(200, doc)
+                    # api/v1/namespaces/{ns}/leases/{name}
+                    elif (
+                        len(parts) == 6
+                        and parts[2] == "namespaces"
+                        and parts[4] == "leases"
+                    ):
+                        lease = server.leases.get(
+                            f"{parts[3]}/{parts[5]}"
+                        )
+                        if lease is None:
+                            self._reply(404, {"error": self.path})
+                        else:
+                            self._reply(
+                                200, server._lease_doc(
+                                    parts[3], parts[5], lease
+                                )
+                            )
                     else:
                         self._reply(404, {"error": self.path})
 
@@ -314,12 +359,35 @@ class FakeApiServer:
                         if node not in server.nodes:
                             self._reply(404, {"error": f"no node {node}"})
                             return
+                        # the real apiserver answers 409 Conflict when
+                        # a binding already exists; queued ops fold in
+                        # first so "already bound" is authoritative in
+                        # POST order (a MIGRATE's evict+bind still
+                        # lands as one move)
+                        server._apply_pending()
+                        cur = server.pods[key].get("spec", {}).get(
+                            "nodeName", ""
+                        )
+                        if cur:
+                            self._reply(
+                                409,
+                                {"kind": "Status", "code": 409,
+                                 "reason": "Conflict",
+                                 "message": f"pod {key} is already "
+                                            f"bound to {cur}"},
+                            )
+                            return
                         server._pending_ops.append(("bind", key, node))
                         server.bindings.append((key, node))
                         # wake parked watch streams so the binding
                         # becomes observable at their next wake, like
                         # the next poll would make it
                         server._cond.notify_all()
+                        if server._take_apply_then_disconnect():
+                            # crash injection: the op IS applied, the
+                            # caller never hears back
+                            self.close_connection = True
+                            return
                         self._reply(201, {"status": "Bound"})
                     # api/v1/namespaces/{ns}/pods/{name}/eviction
                     elif (
@@ -335,9 +403,96 @@ class FakeApiServer:
                         server._pending_ops.append(("evict", key, ""))
                         server.evictions.append(key)
                         server._cond.notify_all()
+                        if server._take_apply_then_disconnect():
+                            self.close_connection = True
+                            return
                         self._reply(201, {"status": "Evicted"})
                     else:
                         self._reply(404, {"error": self.path})
+
+            # ---- leases (leader election, ha/standby.py) -----------
+
+            def do_PUT(self):
+                if self._apply_fault(self._injected_fault()):
+                    return
+                url = urlparse(self.path)
+                parts = url.path.strip("/").split("/")
+                if not (
+                    len(parts) == 6
+                    and parts[2] == "namespaces"
+                    and parts[4] == "leases"
+                ):
+                    self._reply(404, {"error": self.path})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                spec = body.get("spec", {})
+                holder = str(spec.get("holderIdentity", ""))
+                duration = float(
+                    spec.get("leaseDurationSeconds", 15) or 15
+                )
+                key = f"{parts[3]}/{parts[5]}"
+                with server._lock:
+                    cur = server.leases.get(key)
+                    now = time.time()
+                    expired = (
+                        cur is not None
+                        and now - cur["renew_unix"] > cur["duration_s"]
+                    )
+                    if (cur is None or expired
+                            or cur["holder"] == holder):
+                        transitions = (
+                            cur["transitions"]
+                            + (1 if cur["holder"] != holder else 0)
+                        ) if cur is not None else 0
+                        server.leases[key] = {
+                            "holder": holder,
+                            "duration_s": duration,
+                            "renew_unix": now,
+                            "transitions": transitions,
+                        }
+                        self._reply(
+                            200, server._lease_doc(
+                                parts[3], parts[5], server.leases[key]
+                            )
+                        )
+                    else:
+                        self._reply(
+                            409,
+                            {"kind": "Status", "code": 409,
+                             "reason": "Conflict",
+                             "details": {"holder": cur["holder"]}},
+                        )
+
+            def do_DELETE(self):
+                if self._apply_fault(self._injected_fault()):
+                    return
+                url = urlparse(self.path)
+                query = parse_qs(url.query)
+                parts = url.path.strip("/").split("/")
+                if not (
+                    len(parts) == 6
+                    and parts[2] == "namespaces"
+                    and parts[4] == "leases"
+                ):
+                    self._reply(404, {"error": self.path})
+                    return
+                key = f"{parts[3]}/{parts[5]}"
+                identity = query.get("holderIdentity", [""])[0]
+                with server._lock:
+                    cur = server.leases.get(key)
+                    if cur is None:
+                        self._reply(404, {"error": self.path})
+                    elif identity and cur["holder"] != identity:
+                        self._reply(
+                            409,
+                            {"kind": "Status", "code": 409,
+                             "reason": "Conflict",
+                             "details": {"holder": cur["holder"]}},
+                        )
+                    else:
+                        del server.leases[key]
+                        self._reply(200, {"status": "Released"})
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.port = self._httpd.server_address[1]
@@ -400,6 +555,27 @@ class FakeApiServer:
         next wake would otherwise pick nondeterministically)."""
         with self._lock:
             self._apply_pending()
+
+    @staticmethod
+    def _lease_doc(ns: str, name: str, lease: dict) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "holderIdentity": lease["holder"],
+                "leaseDurationSeconds": lease["duration_s"],
+                "renewTime": lease["renew_unix"],
+                "leaseTransitions": lease["transitions"],
+            },
+        }
+
+    def _take_apply_then_disconnect(self) -> bool:
+        """Consume one armed apply-then-disconnect fault (lock held)."""
+        if self._apply_then_disconnect_next > 0:
+            self._apply_then_disconnect_next -= 1
+            return True
+        return False
 
     @staticmethod
     def _select(items, selector: str) -> list[dict]:
@@ -544,6 +720,16 @@ class FakeApiServer:
         half delivered)."""
         with self._lock:
             self._disconnect_next = n
+
+    def apply_then_disconnect_next(self, n: int) -> None:
+        """The crash-consistency fault: the next n mutation POSTs are
+        APPLIED server-side, then the connection dies without a
+        response — exactly what a scheduler crash between the POST
+        landing and the ack being read produces. The caller's journal
+        replay must treat the re-issued op as already-applied (bind
+        409-on-same-target = success), never double-actuate."""
+        with self._lock:
+            self._apply_then_disconnect_next = n
 
     def gone_next_watch(self, n: int) -> None:
         """Answer the next n watch connects with HTTP 410 Gone."""

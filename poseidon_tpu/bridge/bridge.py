@@ -1543,6 +1543,49 @@ class SchedulerBridge:
                     label="fetch abandoned in cancel_round",
                 )
 
+    def restore_state(
+        self,
+        *,
+        machines: list[Machine],
+        tasks: list[Task],
+        round_num: int,
+        knowledge_state: dict | None = None,
+        builder_cols=None,
+    ) -> None:
+        """Warm-restore rehydration (ha/checkpoint.py): adopt a
+        checkpointed cluster image wholesale on a freshly-constructed
+        bridge — tasks/machines in their checkpointed insertion order
+        (the pending order every graph build depends on), the derived
+        ``pod_to_machine`` set, the knowledge sample rings, and
+        (optionally) the incremental builder's patchable columns so the
+        first post-restore build patches instead of re-extracting.
+        The on-HBM express context did not survive the process, so the
+        express bookkeeping starts clean; mass-eviction-guard strikes
+        reset (a restore begins a fresh observation history — the
+        guard itself stays armed across the boundary)."""
+        self.machines = {m.name: m for m in machines}
+        self.tasks = {t.uid: t for t in tasks}
+        self.pod_to_machine = {
+            t.uid: t.machine for t in tasks
+            if t.phase == TaskPhase.RUNNING
+            and t.machine in self.machines
+        }
+        self.round_num = int(round_num)
+        if knowledge_state is not None:
+            self.knowledge.restore_state(knowledge_state)
+        if self._graph is not None:
+            if builder_cols is not None:
+                self._graph.restore_columns(builder_cols)
+            else:
+                self._graph.note_full_rebuild("restore")
+        self._express_retire = []
+        self._express_unconfirmed.clear()
+        self._express_placed.clear()
+        if self.express_lane:
+            self.solver.invalidate_express()
+        self._node_shrink_strikes = 0
+        self._pod_shrink_strikes = 0
+
     @property
     def solver_timeout_s(self) -> float:
         """Oracle-fallback budget; delegates to the live solver (the

@@ -1,0 +1,38 @@
+"""High availability: crash-safe checkpoints, actuation journal, HA.
+
+The scheduler replaces kube-scheduler outright — a process death is a
+cluster-wide placement outage — and every ounce of its performance
+lives in warm state a restart would otherwise throw away (HBM
+prices/layouts, grow-only pad floors, the bridge pod state machine,
+the watch resourceVersion). This package makes restarts survivable:
+
+- ``checkpoint.py``: atomic versioned warm-state snapshots (tmp +
+  rename, checksummed, torn-write tolerant) taken on a round cadence,
+  and the restore path that rehydrates bridge + solver + incremental
+  builder and resumes the watch from the checkpointed rv;
+- ``journal.py``: a write-ahead actuation journal — every bind/evict
+  POST is journaled intent -> posted -> confirmed, fsync'd before the
+  wire, so a restart replays incomplete actuations idempotently and
+  never double-binds or loses a placement the apiserver accepted;
+- ``standby.py``: Lease-style leader election + a warm standby that
+  follows checkpoints and takes over without a cold start.
+"""
+
+from poseidon_tpu.ha.checkpoint import (
+    CheckpointManager,
+    CheckpointSnapshot,
+    load_latest,
+    restore_bridge,
+)
+from poseidon_tpu.ha.journal import ActuationJournal, replay_journal
+from poseidon_tpu.ha.standby import LeaderElector
+
+__all__ = [
+    "ActuationJournal",
+    "CheckpointManager",
+    "CheckpointSnapshot",
+    "LeaderElector",
+    "load_latest",
+    "replay_journal",
+    "restore_bridge",
+]

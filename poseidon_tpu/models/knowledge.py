@@ -109,6 +109,38 @@ class _RingStore:
             self._buf[f, row, slot] = v32
         self._count[row] += 1
 
+    def export_state(self) -> dict:
+        """Host-array snapshot for checkpointing (ha/checkpoint.py).
+
+        The rings mutate in place every observe tick, so the arrays are
+        copied here; ``restore_state`` of the returned dict reproduces
+        the store bit-exactly — the aggregates the cost models consume
+        are running sums over these buffers, so a restored scheduler
+        prices the next round from the same utilization history the
+        crashed one held, not from one cold re-observed sample.
+        """
+        return {
+            "buf": np.array(self._buf, copy=True),
+            "sum": np.array(self._sum, copy=True),
+            "count": np.array(self._count, copy=True),
+            "idx": dict(self._idx),
+            "free": list(self._free),
+            "queue_size": self.queue_size,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Adopt an ``export_state`` snapshot wholesale."""
+        if int(state["queue_size"]) != self.queue_size:
+            raise ValueError(
+                f"checkpointed queue_size {state['queue_size']} != "
+                f"configured {self.queue_size}"
+            )
+        self._buf = np.array(state["buf"], np.float32, copy=True)
+        self._sum = np.array(state["sum"], np.float64, copy=True)
+        self._count = np.array(state["count"], np.int64, copy=True)
+        self._idx = {str(k): int(v) for k, v in state["idx"].items()}
+        self._free = [int(r) for r in state["free"]]
+
     def means(
         self, names: list[str], field: int, default: float
     ) -> np.ndarray:
@@ -172,3 +204,22 @@ class KnowledgeBase:
 
     def task_cpu_usage(self, uids: list[str]) -> np.ndarray:
         return self._tasks.means(uids, 0, 0.0)
+
+    # ---- checkpoint/restore (ha/checkpoint.py) ----
+
+    def export_state(self) -> dict:
+        """Both stores' ring state, copied (see ``_RingStore``)."""
+        return {
+            "queue_size": self.queue_size,
+            "machines": self._machines.export_state(),
+            "tasks": self._tasks.export_state(),
+        }
+
+    def restore_state(self, state: dict) -> None:
+        if int(state["queue_size"]) != self.queue_size:
+            raise ValueError(
+                f"checkpointed queue_size {state['queue_size']} != "
+                f"configured {self.queue_size}"
+            )
+        self._machines.restore_state(state["machines"])
+        self._tasks.restore_state(state["tasks"])

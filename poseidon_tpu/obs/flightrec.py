@@ -141,6 +141,12 @@ class FlightRecorder:
     # recorder into the incident. "manual" dumps are never throttled.
     COOLDOWN_S = 300.0
 
+    # default on-disk retention: newest dumps kept per --flight_dir (a
+    # flapping daemon writing one ring .npz per cooldown window must
+    # not fill the disk before anyone reads the evidence); 0 disables
+    # the GC entirely
+    MAX_DUMPS_DEFAULT = 16
+
     def __init__(
         self,
         out_dir: str = "flightrec",
@@ -148,14 +154,17 @@ class FlightRecorder:
         rounds: int = FLIGHT_ROUNDS_DEFAULT,
         metrics=None,
         cooldown_s: float = COOLDOWN_S,
+        max_dumps: int = MAX_DUMPS_DEFAULT,
     ):
         self.out_dir = out_dir
         self.rounds = max(int(rounds), 1)
         self.metrics = metrics
         self.cooldown_s = cooldown_s
+        self.max_dumps = max(int(max_dumps), 0)
         self.records: collections.deque = collections.deque()
         self.dumps_total = 0
         self.dumps_suppressed = 0
+        self.dumps_pruned = 0
         self._seq = 0
         self._last_dump: dict[str, float] = {}
         # boot-unique filename token: a restarted daemon's round
@@ -424,7 +433,32 @@ class FlightRecorder:
             "(reason=%s%s)", len(self.records), stem, reason,
             f": {label}" if label else "",
         )
+        self._prune_dumps()
         return stem + ".json"
+
+    def _prune_dumps(self) -> None:
+        """Bound the on-disk dump set to the ``max_dumps`` most recent
+        (oldest-first GC over every ``flightrec-*`` stem in the
+        directory — previous boots' dumps age out the same way, which
+        is the point: the disk bound must hold across restarts)."""
+        if not self.max_dumps:
+            return
+        try:
+            names = sorted(
+                n for n in os.listdir(self.out_dir)
+                if n.startswith("flightrec-") and n.endswith(".json")
+            )
+        except OSError:
+            return
+        for stale in names[:-self.max_dumps]:
+            stem = os.path.join(self.out_dir, stale[: -len(".json")])
+            for suffix in (".json", ".npz"):
+                try:
+                    os.remove(stem + suffix)
+                except OSError:
+                    pass
+            self.dumps_pruned += 1
+            log.info("flight recorder pruned old dump %s", stem)
 
 
 # ---------------------------------------------------------------------------

@@ -371,6 +371,27 @@ class SchedulerMetrics:
             "reason (degrade/express-degrade/fetch-timeout/"
             "resync-storm/manual)",
         )
+        # ---- crash safety / HA (poseidon_tpu/ha/) ----
+        self.checkpoint_bytes = registry.gauge(
+            "poseidon_checkpoint_bytes",
+            "size of the most recent completed warm-state checkpoint "
+            "(npz + manifest)",
+        )
+        self.checkpoint_age = registry.gauge(
+            "poseidon_checkpoint_age_seconds",
+            "seconds since the most recent completed warm-state "
+            "checkpoint (alert when this exceeds a few cadences: the "
+            "writer is wedged or failing)",
+        )
+        self.journal_replays = registry.counter(
+            "poseidon_journal_replays_total",
+            "incomplete journaled actuations replayed at restart, by "
+            "outcome (replayed/already-applied/stale/failed/conflict)",
+        )
+        self.restores = registry.counter(
+            "poseidon_restores_total",
+            "warm-state restores performed at startup",
+        )
         self.build_info = registry.gauge(
             "poseidon_build_info",
             "constant 1; the labels carry the build identity "
@@ -491,6 +512,24 @@ class SchedulerMetrics:
         """One flight-recorder dump written (reason is the recorder's
         own bounded vocabulary, flightrec.DUMP_REASONS)."""
         self.flightrec_dumps.inc(reason=reason)
+
+    # ---- crash safety / HA (poseidon_tpu/ha/) --------------------------
+
+    def record_checkpoint(self, nbytes: int) -> None:
+        """One completed checkpoint write (writer thread; host ints —
+        the registry lock is the cross-thread discipline)."""
+        self.checkpoint_bytes.set(nbytes)
+        self.checkpoint_age.set(0.0)
+
+    def record_checkpoint_age(self, age_s: float) -> None:
+        """Driver-thread per-round age refresh (host float)."""
+        self.checkpoint_age.set(age_s)
+
+    def record_journal_replay(self, outcome: str) -> None:
+        self.journal_replays.inc(outcome=outcome)
+
+    def record_restore(self) -> None:
+        self.restores.inc()
 
     def set_build_info(self, info: dict) -> None:
         """Publish the build-identity gauge (value 1, labels = the

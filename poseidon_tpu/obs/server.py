@@ -23,7 +23,10 @@ the discipline). Endpoints:
   readiness on a solve would wedge a readiness-gated rollout there
   forever). Until then 503 with the missing conditions in the body, so
   an operator can tell "waiting for the apiserver" from "waiting for
-  the first solve". Degraded-to-oracle and resync-storm states are NOT
+  the first solve". A 200 body carries the ``restored_warm`` condition
+  detail when the daemon rehydrated from a checkpoint at startup
+  (ha/checkpoint.py) — informational, never a gate. Degraded-to-oracle
+  and resync-storm states are NOT
   readiness failures — they surface as labeled gauges
   (``poseidon_degraded{why=...}``, ``poseidon_watch_resync_storm``)
   since a degraded scheduler is still scheduling.
@@ -63,6 +66,11 @@ class HealthState:
         self._lock = threading.Lock()
         self._seeded = False
         self._round_done = False
+        # informational condition detail, not a readiness gate: True
+        # when the daemon rehydrated warm state from a checkpoint at
+        # startup (ha/checkpoint.py) — "did this pod cold-start or
+        # warm-restore" is the first rollout question after a bounce
+        self._restored_warm = False
         self._gauge = ready_gauge
         if ready_gauge is not None:
             ready_gauge.set(0)
@@ -92,6 +100,17 @@ class HealthState:
                 self._gauge.set(
                     1 if self._seeded and self._round_done else 0
                 )
+
+    def mark_restored_warm(self) -> None:
+        """The startup path rehydrated warm state from a checkpoint
+        (surfaced as a /readyz condition detail, never a gate)."""
+        with self._lock:
+            self._restored_warm = True
+
+    @property
+    def restored_warm(self) -> bool:
+        with self._lock:
+            return self._restored_warm
 
     @property
     def ready(self) -> bool:
@@ -158,7 +177,13 @@ class ObsServer:
                                      "application/json")
                 elif route == "/readyz":
                     if health.ready:
-                        body = b"ready\n"
+                        # condition detail: did this process warm-
+                        # restore from a checkpoint or cold-start?
+                        body = (
+                            b"ready restored_warm=true\n"
+                            if health.restored_warm
+                            else b"ready\n"
+                        )
                         self.send_response(200)
                     else:
                         body = (

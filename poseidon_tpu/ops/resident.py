@@ -1052,13 +1052,17 @@ class ResidentSolver:
         self, floors: dict[str, int] | None,
         warm_seed: tuple | None,
     ) -> None:
-        """Offline-replay seeding (obs/replay.py): restore recorded
-        padding floors and (optionally) upload a recorded warm
-        (asg, lvl, floor) mirror as the next round's warm start — the
-        recorded round then re-runs the exact compiled program the live
-        round ran, from the same starting state, so the replayed
-        assignment/cost are bit-identical. Never called on the live
-        path."""
+        """Replay/restore seeding: restore recorded padding floors and
+        (optionally) upload a recorded warm (asg, lvl, floor) mirror
+        as the next round's warm start — the next round then runs the
+        exact compiled program the recorded round ran, from the same
+        starting state, so assignment/cost are bit-identical and the
+        restored floors keep the steady state at zero recompiles. Two
+        callers, both OFF the round's hot path: the offline replay
+        harness (obs/replay.py) and the startup warm restore
+        (ha/checkpoint.restore_bridge — the crash-safety layer's
+        whole point is that a restarted daemon re-enters here instead
+        of a cold solve)."""
         if floors:
             self._e_floor = floors["e"]
             self._t_floor = floors["t"]

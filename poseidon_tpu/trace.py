@@ -23,7 +23,12 @@ between round ticks by the on-HBM incremental re-solve),
 EXPRESS_CORRECTED (the periodic correction round moved an express
 placement — the differential-verify outcome), and EXPRESS_DEGRADE (an
 express batch fell back to the round path, ``detail.why`` names the
-guard that fired),
+guard that fired). The crash-safety layer (``--checkpoint_dir``,
+poseidon_tpu/ha/) adds CHECKPOINT (a warm-state snapshot captured),
+RESTORE (the daemon rehydrated from a checkpoint at startup —
+``detail.warm`` says whether the solve seed survived) and
+JOURNAL_REPLAY (an incomplete journaled actuation replayed
+idempotently on restart, ``detail.op``/``detail.outcome``),
 plus ROUND records carrying the per-phase timing/stat payload
 (``SchedulerStats`` as a dict — including the round-pipeline timers:
 ``build_mode`` delta/full/legacy, ``dispatch_ms``, ``fetch_wait_ms``,
@@ -96,6 +101,13 @@ EVENT_TYPES = frozenset({
     "FLIGHTREC_DUMP",   # the anomaly flight recorder wrote a dump
                         # (detail.reason names the trigger, detail.path
                         # the manifest; obs/flightrec.py)
+    "CHECKPOINT",       # a warm-state checkpoint was captured
+                        # (ha/checkpoint.py; detail.round/cadence)
+    "RESTORE",          # the daemon rehydrated from a checkpoint at
+                        # startup (detail.round/warm/rv)
+    "JOURNAL_REPLAY",   # an incomplete journaled actuation was
+                        # replayed idempotently on restart
+                        # (ha/journal.py; detail.op/outcome)
 })
 
 

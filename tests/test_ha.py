@@ -1,0 +1,1164 @@
+"""Crash safety & HA (poseidon_tpu/ha/, ISSUE 13).
+
+The contract under test, in four layers:
+
+- **Checkpoints** round-trip the full warm surface (bridge state with
+  aging, knowledge rings, pad floors, warm solve seed, builder
+  columns, watch rv) through an atomic, checksummed, torn-write-
+  tolerant on-disk format;
+- **Restore is invisible**: the first post-restore round is
+  bit-identical (assignment + cost + deltas) to the uninterrupted
+  twin's, with preemption on and off and the express flag on and off,
+  and the restored build is a warm delta patch, not a cold rebuild;
+- **The journal yields exactly-once actuation**: across every injected
+  kill point — before any POST, mid-actuation, after a POST landed
+  but before its ack, between journal phases, mid-checkpoint-write —
+  restart + idempotent replay converges to the same final cluster
+  state and the same first-post-restart round as the crash-free
+  baseline, with no duplicate and no lost bindings;
+- **HA**: Lease-style leader election on the fake apiserver, and a
+  warm standby that follows checkpoints and takes over without a cold
+  start.
+
+Plus the PR's satellites: bind-POST 409-same-target idempotency,
+flight-recorder dump retention, SIGTERM graceful shutdown (in-process
+latch + a real subprocess), and the /readyz ``restored_warm`` detail.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.apiclient import FakeApiServer, K8sApiClient
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.cluster import Machine, Task
+from poseidon_tpu.ha import (
+    ActuationJournal,
+    CheckpointManager,
+    LeaderElector,
+    load_latest,
+    replay_journal,
+    restore_bridge,
+)
+from poseidon_tpu.ha.journal import incomplete_entries
+from poseidon_tpu.ha.standby import follow_checkpoints
+
+
+def make_bridge(**kw):
+    kw.setdefault("small_to_oracle", False)
+    return SchedulerBridge(cost_model=kw.pop("cost_model", "quincy"),
+                           **kw)
+
+
+def synth_machines(n=6):
+    return [
+        Machine(
+            name=f"n{i}", cpu_capacity=8.0, cpu_allocatable=8.0,
+            memory_capacity_kb=1 << 24, memory_allocatable_kb=1 << 24,
+            rack=f"r{i % 2}", max_tasks=8,
+        )
+        for i in range(n)
+    ]
+
+
+def synth_tasks(n=18, n_m=6, start=0):
+    return [
+        Task(
+            uid=f"p{j:03d}", cpu_request=0.25, memory_request_kb=256,
+            job=f"j{j // 6}",
+            data_prefs={f"n{j % n_m}": 50} if j % 3 == 0 else {},
+        )
+        for j in range(start, start + n)
+    ]
+
+
+def run_and_confirm(bridge):
+    r = bridge.run_scheduler()
+    for uid, m in r.bindings.items():
+        bridge.confirm_binding(uid, m)
+    for uid, (_f, to) in r.migrations.items():
+        bridge.confirm_migration(uid, to)
+    for uid in r.preemptions:
+        bridge.confirm_preemption(uid)
+    return r
+
+
+def _populate(server, n_nodes=5, n_pods=15):
+    for i in range(n_nodes):
+        server.add_node(f"n{i}", cpu="8", memory="16Gi", pods=8,
+                        rack=f"r{i % 2}")
+    for j in range(n_pods):
+        prefs = {f"n{j % n_nodes}": 50} if j % 3 == 0 else None
+        server.add_pod(f"p{j:03d}", cpu="250m", memory="256Mi",
+                       job=f"j{j // 5}", data_prefs=prefs)
+
+
+# ---------------------------------------------------------------------------
+# checkpoints
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointRoundTrip:
+    def _warm_bridge(self):
+        b = make_bridge()
+        b.observe_nodes(synth_machines())
+        b.observe_pods(synth_tasks())
+        run_and_confirm(b)
+        # churn + a second round so the warm seed and delta columns
+        # are genuinely exercised state, not first-round accidents
+        b.observe_pod_event("ADDED", Task(
+            uid="x000", cpu_request=0.1, memory_request_kb=128,
+        ))
+        run_and_confirm(b)
+        return b
+
+    def test_round_trip_equality(self, tmp_path):
+        b = self._warm_bridge()
+        mgr = CheckpointManager(str(tmp_path))
+        snap = mgr.capture(b)
+        assert snap.warm_seed is not None
+        assert snap.cols is not None
+        mgr.write_sync(snap)
+        got = load_latest(str(tmp_path))
+        assert got is not None
+        assert got.round_num == b.round_num
+        assert got.tasks == list(b.tasks.values())
+        assert got.machines == list(b.machines.values())
+        assert got.pad_floors == b.solver.pad_floors
+        for a, g in zip(snap.warm_seed, got.warm_seed):
+            assert np.array_equal(a, g)
+        # knowledge aggregates reproduce bit-exactly
+        names = list(b.machines)
+        restored = make_bridge()
+        restored.knowledge.restore_state(got.knowledge)
+        assert np.array_equal(
+            b.knowledge.machine_load(names),
+            restored.knowledge.machine_load(names),
+        )
+        uids = list(b.tasks)
+        assert np.array_equal(
+            b.knowledge.task_cpu_usage(uids),
+            restored.knowledge.task_cpu_usage(uids),
+        )
+        # builder columns round-trip (numeric + object columns)
+        assert got.cols.machine_names == snap.cols.machine_names
+        assert got.cols.uids.tolist() == snap.cols.uids.tolist()
+        assert np.array_equal(got.cols.pref_m, snap.cols.pref_m)
+
+    def test_prune_keeps_newest(self, tmp_path):
+        b = self._warm_bridge()
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for _ in range(4):
+            mgr.write_sync(mgr.capture(b))
+        manifests = [n for n in os.listdir(tmp_path)
+                     if n.endswith(".json")]
+        assert len(manifests) == 2
+
+    def test_torn_npz_falls_back(self, tmp_path):
+        b = self._warm_bridge()
+        mgr = CheckpointManager(str(tmp_path), keep=4)
+        mgr.write_sync(mgr.capture(b))
+        first_round = b.round_num
+        run_and_confirm(b)
+        mgr.write_sync(mgr.capture(b))
+        newest = sorted(
+            n for n in os.listdir(tmp_path) if n.endswith(".npz")
+        )[-1]
+        path = tmp_path / newest
+        data = path.read_bytes()
+        path.write_bytes(data[: len(data) // 2])  # torn write
+        got = load_latest(str(tmp_path))
+        assert got is not None
+        assert got.round_num == first_round
+
+    def test_manifest_without_npz_skipped(self, tmp_path):
+        b = self._warm_bridge()
+        mgr = CheckpointManager(str(tmp_path), keep=4)
+        mgr.write_sync(mgr.capture(b))
+        first_round = b.round_num
+        run_and_confirm(b)
+        mgr.write_sync(mgr.capture(b))
+        newest = sorted(
+            n for n in os.listdir(tmp_path) if n.endswith(".npz")
+        )[-1]
+        os.remove(tmp_path / newest)
+        got = load_latest(str(tmp_path))
+        assert got.round_num == first_round
+
+    def test_empty_dir_is_none(self, tmp_path):
+        assert load_latest(str(tmp_path)) is None
+        assert load_latest(str(tmp_path / "missing")) is None
+
+    def test_mismatched_cost_model_drops_warm_keeps_floors(
+        self, tmp_path
+    ):
+        b = self._warm_bridge()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write_sync(mgr.capture(b))
+        other = make_bridge(cost_model="octopus")
+        restore_bridge(other, load_latest(str(tmp_path)))
+        assert other.solver.warm_seed_host is None
+        assert other.solver.pad_floors == b.solver.pad_floors
+
+    def test_cross_boot_ordering_survives_round_reset(self, tmp_path):
+        """Regression: a cold-restarted daemon's round numbers reset,
+        and round-numbered stems alone would sort the fresh boot's
+        checkpoints BEFORE the dead boot's — pruning the new ones and
+        restoring ancient state. The boot token keeps newest-boot
+        newest."""
+        b = self._warm_bridge()  # round_num ~2 after two rounds
+        old_mgr = CheckpointManager(str(tmp_path), keep=2)
+        old_snap = old_mgr.capture(b)
+        old_snap.round_num = 100  # the long-lived dead boot
+        old_mgr.write_sync(old_snap)
+        time.sleep(0.002)  # ms-resolution boot token
+        new_mgr = CheckpointManager(str(tmp_path), keep=2)
+        new_snap = new_mgr.capture(b)
+        new_snap.round_num = 1  # fresh boot, counters reset
+        new_mgr.write_sync(new_snap)
+        got = load_latest(str(tmp_path))
+        assert got.round_num == 1, "resurrected the dead boot's state"
+        new_mgr.write_sync(new_snap)  # prune (keep=2) runs
+        kept = sorted(
+            n for n in os.listdir(tmp_path) if n.endswith(".json")
+        )
+        assert len(kept) == 2
+        assert all("-r00000001-" in n for n in kept), kept
+
+    def test_background_writer_lands(self, tmp_path):
+        b = self._warm_bridge()
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.submit(mgr.capture(b))
+        mgr.close()
+        assert load_latest(str(tmp_path)) is not None
+        assert mgr.writes_total == 1
+
+
+# ---------------------------------------------------------------------------
+# restore differential: the first post-restore round is bit-identical
+# ---------------------------------------------------------------------------
+
+
+class TestRestoreDifferential:
+    @pytest.mark.parametrize("express", [False, True])
+    @pytest.mark.parametrize("preemption", [False, True])
+    def test_first_round_bit_identical(
+        self, tmp_path, preemption, express
+    ):
+        flags = dict(enable_preemption=preemption, express_lane=express)
+        A = make_bridge(**flags)
+        A.observe_nodes(synth_machines())
+        A.observe_pods(synth_tasks())
+        run_and_confirm(A)
+        # churn (arrival + completion) and a second round: the
+        # checkpoint captures genuinely warm state
+        done = next(iter(A.pod_to_machine))
+        A.observe_pod_event("DELETED", A.tasks[done])
+        A.observe_pod_event("ADDED", Task(
+            uid="x000", cpu_request=0.1, memory_request_kb=128,
+            data_prefs={"n2": 70},
+        ))
+        run_and_confirm(A)
+
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write_sync(mgr.capture(A))
+
+        # both twins observe the SAME post-checkpoint events through
+        # the tick path, then run one round
+        arrivals = [
+            Task(uid="x001", cpu_request=0.1, memory_request_kb=128,
+                 data_prefs={"n3": 70}),
+            Task(uid="x002", cpu_request=0.3, memory_request_kb=512),
+        ]
+        for t in arrivals:
+            A.observe_pod_event("ADDED", t)
+        rA = A.run_scheduler()
+
+        B = make_bridge(**flags)
+        snap = load_latest(str(tmp_path))
+        assert snap.warm_seed is not None, "checkpoint lost the seed"
+        restore_bridge(B, snap)
+        for t in arrivals:
+            B.observe_pod_event("ADDED", t)
+        rB = B.run_scheduler()
+
+        assert rB.stats.cost == rA.stats.cost
+        assert rB.bindings == rA.bindings
+        assert rB.migrations == rA.migrations
+        assert rB.preemptions == rA.preemptions
+        assert rB.stats.backend == rA.stats.backend
+        # the restore was WARM: the primed builder columns patched
+        # (no cold re-extract) and the dense lane solved
+        assert rB.stats.build_mode == "delta"
+        assert rB.stats.backend == "dense_auction"
+
+    def test_restored_bridge_keeps_scheduling(self, tmp_path):
+        """Sanity past the first round: the restored daemon keeps
+        placing new work (floors/seed are live state, not a one-shot
+        trick)."""
+        A = make_bridge()
+        A.observe_nodes(synth_machines())
+        A.observe_pods(synth_tasks(n=12))
+        run_and_confirm(A)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write_sync(mgr.capture(A))
+        B = make_bridge()
+        restore_bridge(B, load_latest(str(tmp_path)))
+        for k in range(3):
+            B.observe_pod_event("ADDED", Task(
+                uid=f"y{k}", cpu_request=0.1, memory_request_kb=128,
+            ))
+            r = run_and_confirm(B)
+            assert r.stats.pods_placed == 1
+            assert r.stats.backend == "dense_auction"
+
+    def test_rebalancing_restart_no_migration_storm(self, tmp_path):
+        """Acceptance: with rebalancing on, a restart must not
+        actuate spurious migrations — the restored round's deltas
+        match the uninterrupted twin's (zero when the packing was
+        already settled)."""
+        A = make_bridge(enable_preemption=True)
+        A.observe_nodes(synth_machines())
+        A.observe_pods(synth_tasks())
+        run_and_confirm(A)
+        # settle: run rebalancing rounds until no deltas remain
+        for _ in range(4):
+            r = run_and_confirm(A)
+            if not (r.migrations or r.preemptions):
+                break
+        settled = run_and_confirm(A)
+        assert not settled.migrations and not settled.preemptions
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write_sync(mgr.capture(A))
+        B = make_bridge(enable_preemption=True)
+        restore_bridge(B, load_latest(str(tmp_path)))
+        rB = B.run_scheduler()
+        assert rB.migrations == {}
+        assert rB.preemptions == {}
+
+
+# ---------------------------------------------------------------------------
+# watch resume from the checkpointed rv
+# ---------------------------------------------------------------------------
+
+
+class TestWatchResume:
+    def test_resume_delivers_only_post_checkpoint_events(self):
+        from poseidon_tpu.apiclient.watch import ClusterWatcher
+
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=2, n_pods=3)
+            client = K8sApiClient("127.0.0.1", server.port)
+            w1 = ClusterWatcher(client)
+            seed = w1.tick()
+            assert seed.resynced
+            rvs = w1.applied_rvs
+            w1.stop()
+            # events after the checkpointed position
+            server.add_pod("late-1", cpu="100m", memory="128Mi")
+            w2 = ClusterWatcher(client)
+            w2.resume(rvs)
+            assert w2.wait_caught_up(server.current_rv())
+            delta = w2.tick()
+            w2.stop()
+            assert not delta.resynced
+            uids = [t.uid for _typ, t in delta.pod_events]
+            assert uids == ["default/late-1"]
+
+    def test_resume_compacted_rv_resyncs_loudly(self):
+        from poseidon_tpu.apiclient.watch import ClusterWatcher
+
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=2, n_pods=3)
+            client = K8sApiClient("127.0.0.1", server.port)
+            w1 = ClusterWatcher(client)
+            w1.tick()
+            rvs = w1.applied_rvs
+            w1.stop()
+            server.add_pod("late-1", cpu="100m", memory="128Mi")
+            server.compact_watch_log()  # rvs now too old: 410
+            w2 = ClusterWatcher(client)
+            w2.resume(rvs)
+            deadline = time.monotonic() + 5.0
+            resynced = False
+            while time.monotonic() < deadline:
+                d = w2.tick()
+                if d.resynced:
+                    resynced = True
+                    assert any(
+                        t.uid == "default/late-1" for t in d.pods
+                    )
+                    break
+                time.sleep(0.02)
+            w2.stop()
+            assert resynced, "compacted rv did not force a resync"
+
+
+# ---------------------------------------------------------------------------
+# the actuation journal
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+    def test_incomplete_folding(self, tmp_path):
+        j = ActuationJournal(str(tmp_path / "j.jsonl"))
+        seqs = j.intents([
+            {"op": "bind", "uid": "a", "machine": "n0"},
+            {"op": "bind", "uid": "b", "machine": "n1"},
+            {"op": "evict", "uid": "c", "from": "n2"},
+        ], 7)
+        j.posted(seqs[("bind", "a")])
+        j.confirmed(seqs[("bind", "a")])
+        j.posted(seqs[("bind", "b")])
+        j.failed(seqs[("evict", "c")])
+        j.close()
+        inc = incomplete_entries(str(tmp_path / "j.jsonl"))
+        # a: confirmed (terminal); b: posted only -> incomplete;
+        # c: failed (terminal)
+        assert [(e.op, e.uid, e.phase) for e in inc] == [
+            ("bind", "b", "posted")
+        ]
+        assert inc[0].round_num == 7
+
+    def test_rotate_keeps_incomplete(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = ActuationJournal(path)
+        seqs = j.intents([
+            {"op": "bind", "uid": "a", "machine": "n0"},
+            {"op": "bind", "uid": "b", "machine": "n1"},
+        ], 1)
+        j.confirmed(seqs[("bind", "a")])
+        assert j.rotate() == 1
+        inc = j.incomplete()
+        assert [(e.op, e.uid) for e in inc] == [("bind", "b")]
+        # seq numbering survives rotation (no reuse)
+        seqs2 = j.intents(
+            [{"op": "bind", "uid": "d", "machine": "n2"}], 2
+        )
+        assert seqs2[("bind", "d")] > seqs[("bind", "b")]
+        j.close()
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = ActuationJournal(path)
+        j.intents([{"op": "bind", "uid": "a", "machine": "n0"}], 1)
+        j.close()
+        with open(path, "a") as fh:
+            fh.write('{"seq": 99, "phase": "conf')  # crash mid-write
+        inc = incomplete_entries(path)
+        assert [(e.op, e.uid) for e in inc] == [("bind", "a")]
+
+    def test_reopen_repairs_torn_tail(self, tmp_path):
+        """Regression: reopening in append mode after a torn write
+        must TRUNCATE the partial tail — appending after it would
+        merge the two into mid-file garbage, and the next rotate()
+        would raise (one crash becoming a crash loop)."""
+        path = str(tmp_path / "j.jsonl")
+        j = ActuationJournal(path)
+        j.intents([{"op": "bind", "uid": "a", "machine": "n0"}], 1)
+        j.close()
+        with open(path, "a") as fh:
+            fh.write('{"seq": 2, "phase": "int')  # crash mid-write
+        j2 = ActuationJournal(path)  # the restart
+        j2.intents([{"op": "bind", "uid": "b", "machine": "n1"}], 2)
+        assert [(e.op, e.uid) for e in j2.incomplete()] == [
+            ("bind", "a"), ("bind", "b"),
+        ]
+        assert j2.rotate() == 2  # parses clean end to end
+        j2.close()
+
+    def test_discard_drops_everything_loudly(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = ActuationJournal(path)
+        j.intents([{"op": "bind", "uid": "a", "machine": "n0"}], 1)
+        assert j.discard() == 1
+        assert j.incomplete() == []
+        j.close()
+
+    def test_replay_bind_lands_and_is_idempotent(self):
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=2, n_pods=2)
+            client = K8sApiClient("127.0.0.1", server.port)
+            entries = incomplete_from_ops([
+                {"op": "bind", "uid": "default/p000", "machine": "n0"},
+            ])
+            out = replay_journal(client, entries)
+            assert out["replayed"] == 1
+            # replaying the same journal again: already-applied, and
+            # the server never records a second binding
+            out2 = replay_journal(client, entries)
+            assert out2["already-applied"] == 1
+            assert server.bindings == [("default/p000", "n0")]
+
+    def test_replay_after_post_landed_without_ack(self):
+        """The POST landed but the daemon died before reading the ack
+        (server-side apply-then-disconnect): replay must converge to
+        exactly-once."""
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=2, n_pods=2)
+            client = K8sApiClient("127.0.0.1", server.port, retries=0)
+            server.apply_then_disconnect_next(1)
+            ok = client.bind_pod_to_node("default/p000", "n0")
+            assert not ok  # the daemon never saw the 201...
+            assert server.bindings == [("default/p000", "n0")]  # ...but it landed
+            entries = incomplete_from_ops([
+                {"op": "bind", "uid": "default/p000", "machine": "n0"},
+            ])
+            out = replay_journal(client, entries)
+            assert out["already-applied"] == 1
+            assert server.bindings == [("default/p000", "n0")]
+
+    def test_replay_stale_and_migrate_halfway(self):
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=3, n_pods=3)
+            client = K8sApiClient("127.0.0.1", server.port)
+            # stale: the pod vanished before restart
+            server.delete_pod("p002")
+            # halfway migrate: the evict landed, the re-bind did not
+            assert client.bind_pod_to_node("default/p001", "n0")
+            assert client.evict_pod("default/p001")
+            entries = incomplete_from_ops([
+                {"op": "bind", "uid": "default/p002", "machine": "n1"},
+                {"op": "migrate", "uid": "default/p001",
+                 "machine": "n2", "from": "n0"},
+            ])
+            out = replay_journal(client, entries)
+            assert out["stale"] == 1
+            assert out["replayed"] == 1
+            pod = client.get_pod("default/p001")
+            assert pod.machine == "n2"
+
+
+def incomplete_from_ops(ops):
+    """Build incomplete JournalEntry objects directly (unit-test
+    shorthand for 'the journal held these intents at the crash')."""
+    from poseidon_tpu.ha.journal import JournalEntry
+
+    return [
+        JournalEntry(
+            seq=i + 1, op=o["op"], uid=o["uid"],
+            machine=o.get("machine", ""),
+            from_machine=o.get("from", ""),
+        )
+        for i, o in enumerate(ops)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# satellite: bind POST 409-same-target = success
+# ---------------------------------------------------------------------------
+
+
+class TestBindConflict409:
+    def test_duplicate_bind_counts_as_success(self):
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=2, n_pods=1)
+            client = K8sApiClient("127.0.0.1", server.port)
+            assert client.bind_pod_to_node("default/p000", "n0")
+            # the duplicate (a retry, a journal replay, a restarted
+            # daemon re-actuating) answers 409 with the SAME target:
+            # success, not bind_failures
+            assert client.bind_pod_to_node("default/p000", "n0")
+            assert server.bindings == [("default/p000", "n0")]
+
+    def test_conflicting_target_still_fails(self):
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=2, n_pods=1)
+            client = K8sApiClient("127.0.0.1", server.port)
+            assert client.bind_pod_to_node("default/p000", "n0")
+            assert not client.bind_pod_to_node("default/p000", "n1")
+
+    def test_driver_does_not_requeue_on_duplicate(self):
+        """Regression: the duplicate POST used to count in
+        bind_failures and age the pod."""
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=2, n_pods=4)
+            client = K8sApiClient("127.0.0.1", server.port)
+            bridge = SchedulerBridge(cost_model="trivial")
+            bridge.observe_nodes(client.all_nodes())
+            bridge.observe_pods(client.all_pods())
+            result = bridge.run_scheduler()
+            from poseidon_tpu.cli import _post_bindings
+
+            for uid, m, ok in _post_bindings(
+                client, bridge, result.bindings
+            ):
+                assert ok
+                bridge.confirm_binding(uid, m)
+            # the whole batch again (a replayed actuation)
+            for uid, m, ok in _post_bindings(
+                client, bridge, result.bindings
+            ):
+                assert ok, f"duplicate bind of {uid} read as failure"
+            r2 = bridge.begin_round()
+            assert r2.stats.bind_failures == 0
+            bridge.cancel_round(r2)
+
+
+# ---------------------------------------------------------------------------
+# crash/restart fault-injection fuzz
+# ---------------------------------------------------------------------------
+
+
+class SimulatedCrash(Exception):
+    pass
+
+
+KILL_POINTS = (
+    "after-intent",          # intents durable, nothing on the wire
+    "mid-actuation",         # half the POSTs landed
+    "between-post-and-mark",  # a POST landed, posted-mark lost
+    "after-posted",          # posted recorded, confirm lost
+    "post-landed-no-ack",    # server applied, connection died
+    "mid-write",             # checkpoint npz staged, crash
+    "pre-manifest",          # checkpoint npz live, manifest staged
+)
+
+
+class _CrashDriver:
+    """A minimal serial driver mirroring cli.run_loop's journaled
+    actuation order (intents -> POST -> posted -> confirm ->
+    confirmed), with named kill points."""
+
+    def __init__(self, server, tmp, preemption, express):
+        self.server = server
+        self.tmp = str(tmp)
+        self.preemption = preemption
+        self.express = express
+        self.client = K8sApiClient(
+            "127.0.0.1", server.port, retries=0
+        )
+
+    def boot(self, restore, crash_hook=None):
+        bridge = make_bridge(
+            enable_preemption=self.preemption,
+            express_lane=self.express,
+        )
+        journal = ActuationJournal(
+            os.path.join(self.tmp, "journal.jsonl")
+        )
+        mgr = CheckpointManager(self.tmp, crash_hook=crash_hook)
+        if restore:
+            snap = load_latest(self.tmp)
+            assert snap is not None
+            restore_bridge(bridge, snap)
+            replay_journal(
+                self.client, journal.incomplete(), journal=journal
+            )
+        bridge.observe_nodes(self.client.all_nodes())
+        bridge.observe_pods(self.client.all_pods())
+        return bridge, journal, mgr
+
+    def round(self, bridge, journal, kill=None):
+        def kp(point):
+            if kill == point:
+                raise SimulatedCrash(point)
+
+        result = bridge.run_scheduler()
+        binds = list(result.bindings.items())
+        seqs = journal.intents(
+            [{"op": "bind", "uid": u, "machine": m}
+             for u, m in binds],
+            bridge.round_num,
+        )
+        kp("after-intent")
+        if kill == "post-landed-no-ack" and binds:
+            self.server.apply_then_disconnect_next(1)
+        for i, (uid, machine) in enumerate(binds):
+            if kill == "mid-actuation" and i == max(len(binds) // 2, 1):
+                raise SimulatedCrash(kill)
+            ok = self.client.bind_pod_to_node(
+                uid, machine, namespace="default"
+            )
+            if kill == "post-landed-no-ack" and i == 0:
+                # the server applied the op; the driver saw a dead
+                # connection — exactly the crash this point models
+                assert not ok
+                raise SimulatedCrash(kill)
+            assert ok
+            kp("between-post-and-mark")
+            journal.posted(seqs[("bind", uid)])
+            kp("after-posted")
+            bridge.confirm_binding(uid, machine)
+            journal.confirmed(seqs[("bind", uid)])
+        return result
+
+
+@pytest.mark.parametrize("preemption,express", [
+    (False, False), (True, False), (False, True), (True, True),
+])
+def test_crash_fuzz_exactly_once_and_twin_identical(
+    tmp_path, preemption, express
+):
+    """Sweep every kill point; assert (a) exactly-once actuation —
+    no duplicate and no lost bindings server-side — and (b) the first
+    post-restart round is bit-identical to the crash-free baseline's
+    (kill-point independence: replay always converges to 'the crashed
+    round fully actuated')."""
+    kill_points = KILL_POINTS if not (preemption or express) else (
+        "after-intent", "mid-actuation", "post-landed-no-ack",
+    )
+    reference = None
+    for kill in (None,) + tuple(kill_points):
+        case = tmp_path / (kill or "baseline")
+        case.mkdir()
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=5, n_pods=10)
+            drv = _CrashDriver(server, case, preemption, express)
+            bridge, journal, mgr = drv.boot(restore=False)
+            drv.round(bridge, journal)            # round 1: places
+            mgr.write_sync(mgr.capture(bridge))   # the checkpoint
+            journal.rotate()
+            # post-checkpoint churn both worlds observe via the poll
+            for k in range(3):
+                server.add_pod(f"x{k}", cpu="100m", memory="128Mi",
+                               data_prefs={f"n{k}": 60})
+            bridge.observe_pods(drv.client.all_pods())
+            if kill in ("mid-write", "pre-manifest"):
+                # the CRASHED CHECKPOINT case: round 2 completes, the
+                # next checkpoint write dies mid-way; restore must
+                # land on the previous complete checkpoint
+                drv.round(bridge, journal)
+
+                def hook(p, _kill=kill):
+                    if p == _kill:
+                        raise SimulatedCrash(p)
+
+                mgr.crash_hook = hook
+                with pytest.raises(SimulatedCrash):
+                    mgr.write_sync(mgr.capture(bridge))
+            elif kill is not None:
+                with pytest.raises(SimulatedCrash):
+                    drv.round(bridge, journal, kill=kill)
+            else:
+                drv.round(bridge, journal)        # baseline round 2
+            journal.close()
+
+            # post-crash arrivals: the first post-restart round has
+            # real work, so the differential is not vacuously empty
+            for k in range(2):
+                server.add_pod(f"z{k}", cpu="100m", memory="128Mi",
+                               data_prefs={f"n{k + 2}": 60})
+
+            # ---- "restart": fresh process state, restore + replay --
+            bridge2, journal2, _ = drv.boot(restore=True)
+            r3 = bridge2.run_scheduler()
+            # replay settled everything: nothing incomplete remains
+            assert journal2.incomplete() == [], (
+                f"kill={kill}: journal not settled after replay"
+            )
+            journal2.close()
+
+            server.apply_pending()
+            bound = {
+                k: d.get("spec", {}).get("nodeName", "")
+                for k, d in server.pods.items()
+            }
+            # exactly-once: the server never accepted a duplicate
+            # binding (each pod at most once in the accepted log)
+            pods_bound_log = [p for p, _n in server.bindings]
+            assert len(pods_bound_log) == len(set(pods_bound_log)), (
+                f"kill={kill}: duplicate binding accepted"
+            )
+            # no lost placements: every churn pod from the crashed
+            # round is bound server-side after replay
+            for k in range(3):
+                assert bound.get(f"default/x{k}"), (
+                    f"kill={kill}: placement of x{k} lost"
+                )
+            outcome = (
+                {k: v for k, v in sorted(bound.items())},
+                r3.stats.cost,
+                dict(sorted(r3.bindings.items())),
+            )
+            if kill is None:
+                reference = outcome
+            else:
+                # kill-point cases: round 2's actuation completed via
+                # replay, so the server state before round 3 must
+                # match the baseline's EXCEPT the not-yet-actuated
+                # round-3 bindings; after actuating r3 everything
+                # matches. Compare the solved round directly:
+                assert outcome[1] == reference[1], (
+                    f"kill={kill}: first post-restart round cost "
+                    f"diverged"
+                )
+                assert outcome[2] == reference[2], (
+                    f"kill={kill}: first post-restart bindings "
+                    f"diverged"
+                )
+                assert outcome[0] == reference[0], (
+                    f"kill={kill}: server state diverged"
+                )
+
+
+# ---------------------------------------------------------------------------
+# leader election + warm standby
+# ---------------------------------------------------------------------------
+
+
+class TestLeaderElection:
+    def test_acquire_conflict_expiry_release(self):
+        with FakeApiServer() as server:
+            client = K8sApiClient("127.0.0.1", server.port)
+            e1 = LeaderElector(client, identity="a", duration_s=0.3)
+            e2 = LeaderElector(client, identity="b", duration_s=0.3)
+            assert e1.try_acquire()
+            assert not e2.try_acquire()
+            assert e1.renew()           # holder renews freely
+            assert not e2.try_acquire()
+            time.sleep(0.4)             # expiry window
+            assert e2.try_acquire()     # takeover after expiry
+            assert not e1.renew()       # the old leader must step down
+            e2.release()
+            assert e1.try_acquire()     # released lease is free now
+
+    def test_leader_steps_down_on_lost_lease(self):
+        """run_loop with a lease that fails renewal must exit 1
+        without scheduling another round (never act on a lost lock)."""
+        from poseidon_tpu.cli import parse_args, run_loop
+
+        class _LostLease:
+            def renew(self):
+                return False
+
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=2, n_pods=4)
+            rc = run_loop(parse_args([
+                f"--k8s_apiserver_port={server.port}",
+                "--k8s_apiserver_host=127.0.0.1",
+                "--flow_scheduling_cost_model=trivial",
+                "--polling_frequency=1000",
+                "--max_rounds=5",
+            ]), lease=_LostLease())
+            assert rc == 1
+            assert server.bindings == []  # stepped down before acting
+
+    def test_warm_standby_takes_over_without_cold_start(
+        self, tmp_path
+    ):
+        """The leader checkpoints; it dies; the standby (which
+        followed the checkpoints) wins the lease and serves its first
+        round WARM: delta build, dense backend, restored solve seed,
+        and zero spurious migrations with rebalancing on."""
+        leader = make_bridge(enable_preemption=True)
+        leader.observe_nodes(synth_machines())
+        leader.observe_pods(synth_tasks())
+        run_and_confirm(leader)
+        for _ in range(4):
+            r = run_and_confirm(leader)
+            if not (r.migrations or r.preemptions):
+                break
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write_sync(mgr.capture(leader))
+
+        with FakeApiServer() as server:
+            client = K8sApiClient("127.0.0.1", server.port)
+            e_leader = LeaderElector(
+                client, identity="leader", duration_s=0.3
+            )
+            e_standby = LeaderElector(
+                client, identity="standby", duration_s=0.3
+            )
+            assert e_leader.try_acquire()
+            # the standby follows checkpoints while waiting
+            snap, mtime = follow_checkpoints(str(tmp_path), None, 0.0)
+            assert snap is not None
+            assert not e_standby.try_acquire()
+            # leader dies (stops renewing); the lease expires
+            time.sleep(0.4)
+            assert e_standby.try_acquire()
+
+        standby = make_bridge(enable_preemption=True)
+        restore_bridge(standby, snap)
+        assert standby.solver.warm_seed_host is not None
+        r = standby.run_scheduler()
+        assert r.stats.build_mode == "delta"
+        assert r.stats.backend == "dense_auction"
+        assert r.migrations == {} and r.preemptions == {}
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown (SIGTERM)
+# ---------------------------------------------------------------------------
+
+
+class TestGracefulShutdown:
+    def test_stop_event_finishes_and_checkpoints(self, tmp_path):
+        """In-process latch: the loop finishes the in-flight round,
+        flushes deltas, exits 0, and leaves a loadable final
+        checkpoint + an untorn trace."""
+        from poseidon_tpu.cli import parse_args, run_loop
+
+        trace_path = str(tmp_path / "trace.jsonl")
+        ckpt_dir = str(tmp_path / "ckpt")
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=4, n_pods=12)
+            stop = threading.Event()
+            args = parse_args([
+                f"--k8s_apiserver_port={server.port}",
+                "--k8s_apiserver_host=127.0.0.1",
+                "--flow_scheduling_cost_model=trivial",
+                "--polling_frequency=20000",
+                f"--checkpoint_dir={ckpt_dir}",
+                "--checkpoint_every=1",
+                f"--trace_log={trace_path}",
+            ])
+            rc_box = {}
+
+            def _run():
+                rc_box["rc"] = run_loop(args, stop_event=stop)
+
+            t = threading.Thread(target=_run)
+            t.start()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    len(server.bindings) < 12:
+                time.sleep(0.05)
+            stop.set()
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert rc_box["rc"] == 0
+            assert len(server.bindings) == 12
+        snap = load_latest(ckpt_dir)
+        assert snap is not None
+        # untorn trace: every line parses (the final flush landed)
+        with open(trace_path) as fh:
+            events = [json.loads(line) for line in fh if line.strip()]
+        assert any(e["event"] == "CHECKPOINT" for e in events)
+
+    def test_sigterm_subprocess_exits_zero(self, tmp_path):
+        """The real signal path: a daemon subprocess gets SIGTERM
+        mid-run and exits 0 with a loadable checkpoint and an untorn
+        trace tail."""
+        trace_path = str(tmp_path / "trace.jsonl")
+        ckpt_dir = str(tmp_path / "ckpt")
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=4, n_pods=12)
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "poseidon_tpu.cli",
+                    f"--k8s_apiserver_port={server.port}",
+                    "--k8s_apiserver_host=127.0.0.1",
+                    "--flow_scheduling_cost_model=trivial",
+                    "--polling_frequency=50000",
+                    f"--checkpoint_dir={ckpt_dir}",
+                    "--checkpoint_every=1",
+                    f"--trace_log={trace_path}",
+                ],
+                env=env,
+            )
+            try:
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline and \
+                        len(server.bindings) < 12:
+                    time.sleep(0.1)
+                assert len(server.bindings) == 12, "daemon never bound"
+                proc.send_signal(signal.SIGTERM)
+                rc = proc.wait(timeout=60)
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+            assert rc == 0
+        assert load_latest(ckpt_dir) is not None
+        with open(trace_path) as fh:
+            for line in fh:
+                if line.strip():
+                    json.loads(line)  # raises on a torn tail
+
+
+# ---------------------------------------------------------------------------
+# observability satellites
+# ---------------------------------------------------------------------------
+
+
+class TestHaObservability:
+    def test_trace_vocabulary(self):
+        from poseidon_tpu.trace import EVENT_TYPES, TraceGenerator
+
+        for ev in ("CHECKPOINT", "RESTORE", "JOURNAL_REPLAY"):
+            assert ev in EVENT_TYPES
+            gen = TraceGenerator()
+            gen.emit(ev, round_num=1)
+            assert gen.events[-1].event == ev
+
+    def test_metrics_families(self):
+        from poseidon_tpu.obs import MetricsRegistry, SchedulerMetrics
+
+        m = SchedulerMetrics(MetricsRegistry())
+        m.record_checkpoint(12345)
+        m.record_checkpoint_age(3.5)
+        m.record_journal_replay("replayed")
+        m.record_journal_replay("already-applied")
+        m.record_restore()
+        text = m.registry.render()
+        assert "poseidon_checkpoint_bytes 12345" in text
+        assert "poseidon_checkpoint_age_seconds 3.5" in text
+        assert ('poseidon_journal_replays_total{outcome="replayed"} 1'
+                in text)
+        assert "poseidon_restores_total 1" in text
+
+    def test_readyz_restored_warm_detail(self):
+        from poseidon_tpu.obs import (
+            HealthState,
+            MetricsRegistry,
+            ObsServer,
+        )
+
+        health = HealthState()
+        srv = ObsServer(MetricsRegistry(), health, port=0,
+                        host="127.0.0.1")
+        port = srv.start()
+        try:
+            health.mark_seeded()
+            health.mark_round("dense_auction")
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz"
+            ) as r:
+                assert r.status == 200
+                assert b"restored_warm" not in r.read()
+            health.mark_restored_warm()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz"
+            ) as r:
+                assert r.status == 200
+                assert b"restored_warm=true" in r.read()
+        finally:
+            srv.stop()
+
+    def test_flight_dump_retention(self, tmp_path):
+        """Satellite: --flight_max_dumps bounds the dump directory
+        (oldest-first GC + dumps_pruned counter)."""
+        from poseidon_tpu.obs.flightrec import FlightRecorder
+
+        fr = FlightRecorder(str(tmp_path), max_dumps=2, cooldown_s=0.0)
+        bridge = make_bridge(flightrec=fr)
+        bridge.observe_nodes(synth_machines(n=3))
+        bridge.observe_pods(synth_tasks(n=6, n_m=3))
+        run_and_confirm(bridge)
+        for _ in range(4):
+            assert bridge.flight_dump("manual") is not None
+        manifests = [n for n in os.listdir(tmp_path)
+                     if n.endswith(".json")]
+        assert len(manifests) == 2
+        assert fr.dumps_pruned == 2
+        assert fr.dumps_total == 4
+        # the survivors are the NEWEST two
+        from poseidon_tpu.obs.flightrec import load_dump
+
+        for n in manifests:
+            load_dump(str(tmp_path / n))
+
+    def test_journal_replays_on_cold_start_without_checkpoint(
+        self, tmp_path
+    ):
+        """Regression: a crash BEFORE the first checkpoint still
+        leaves journaled intents that must settle exactly once — the
+        replay cannot be gated on a snapshot loading."""
+        from poseidon_tpu.cli import parse_args, run_loop
+
+        ckpt_dir = tmp_path / "ckpt"
+        ckpt_dir.mkdir()
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=2, n_pods=2)
+            # the dead boot journaled an intent and never checkpointed
+            j = ActuationJournal(str(ckpt_dir / "journal.jsonl"))
+            j.intents(
+                [{"op": "bind", "uid": "default/p000",
+                  "machine": "n1"}], 1,
+            )
+            j.close()
+            rc = run_loop(parse_args([
+                f"--k8s_apiserver_port={server.port}",
+                "--k8s_apiserver_host=127.0.0.1",
+                "--flow_scheduling_cost_model=trivial",
+                "--polling_frequency=1000",
+                "--max_rounds=1",
+                f"--checkpoint_dir={ckpt_dir}",
+            ]))
+            assert rc == 0
+            # the replay bound p000 to the JOURNALED target (n1);
+            # the round then placed only the other pod — and the
+            # journal settled
+            assert ("default/p000", "n1") in server.bindings
+            pods = [p for p, _n in server.bindings]
+            assert len(pods) == len(set(pods)) == 2
+        assert incomplete_entries(
+            str(ckpt_dir / "journal.jsonl")
+        ) == []
+
+    def test_run_standby_takes_over_and_schedules(self, tmp_path):
+        """The full --standby driver path: a previous boot's
+        checkpoint exists, the lease is free — run_standby must
+        acquire, restore warm (picking up the FINAL checkpoint, not a
+        stale followed one), schedule new work, and exit cleanly."""
+        from poseidon_tpu.cli import parse_args, run_loop
+        from poseidon_tpu.ha.standby import run_standby
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=3, n_pods=6)
+            base = [
+                f"--k8s_apiserver_port={server.port}",
+                "--k8s_apiserver_host=127.0.0.1",
+                "--flow_scheduling_cost_model=trivial",
+                "--polling_frequency=1000",
+                f"--checkpoint_dir={ckpt_dir}",
+                "--checkpoint_every=1",
+                "--standby_lease_s=1.0",
+            ]
+            # the "leader" runs and exits (final checkpoint + lease
+            # never held — it ran without --standby)
+            assert run_loop(parse_args(base + ["--max_rounds=2"])) == 0
+            server.add_pod("late-0", cpu="100m", memory="128Mi")
+            rc = run_standby(parse_args(base + [
+                "--max_rounds=1", "--restore=auto",
+            ]))
+            assert rc == 0
+            assert ("default/late-0", server.bindings[-1][1]) == \
+                server.bindings[-1]
+            assert len(server.bindings) == 7
+
+    def test_restore_emits_trace_and_metrics(self, tmp_path):
+        """cli --restore: RESTORE trace event + restores counter +
+        journal replay accounting, end to end against the fake
+        apiserver."""
+        from poseidon_tpu.cli import parse_args, run_loop
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        trace_path = str(tmp_path / "trace.jsonl")
+        with FakeApiServer() as server:
+            _populate(server, n_nodes=4, n_pods=8)
+            base = [
+                f"--k8s_apiserver_port={server.port}",
+                "--k8s_apiserver_host=127.0.0.1",
+                "--flow_scheduling_cost_model=trivial",
+                "--polling_frequency=1000",
+                f"--checkpoint_dir={ckpt_dir}",
+                "--checkpoint_every=1",
+            ]
+            assert run_loop(parse_args(
+                base + ["--max_rounds=2"]
+            )) == 0
+            server.add_pod("late-0", cpu="100m", memory="128Mi")
+            assert run_loop(parse_args(base + [
+                "--max_rounds=1", "--restore=true",
+                f"--trace_log={trace_path}",
+            ])) == 0
+            assert len(server.bindings) == 9
+        with open(trace_path) as fh:
+            events = [json.loads(line) for line in fh if line.strip()]
+        kinds = [e["event"] for e in events]
+        assert "RESTORE" in kinds
+        restore = next(e for e in events if e["event"] == "RESTORE")
+        assert restore["detail"]["warm"] in (True, False)

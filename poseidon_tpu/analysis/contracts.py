@@ -52,10 +52,31 @@ _OBS_HOT_SCOPES = {
         "FlightRecorder._trim",
         "_copy_meta",
     ),
+    "poseidon_tpu/obs/lifecycle.py": (
+        # lifecycle stamps run inside the round window and the express
+        # fast path: dict ops + clock reads only (note_unscheduled's
+        # percentile runs over the age list the caller's existing
+        # unscheduled walk produced — no second walk, no device)
+        "LifecycleTracker.stamp_event",
+        "LifecycleTracker.backdate_event",
+        "LifecycleTracker.stamp",
+        "LifecycleTracker.stamp_decided",
+        "LifecycleTracker.event_wall_us",
+        "LifecycleTracker.close_confirmed",
+        "LifecycleTracker.close_replayed",
+        "LifecycleTracker.drop",
+        "LifecycleTracker.note_unscheduled",
+        "bounded_lane",
+    ),
     "poseidon_tpu/obs/metrics.py": (
         "Counter.inc",
         "Gauge.set",
         "Histogram.observe",
+        "SchedulerMetrics.record_pod_e2c",
+        "SchedulerMetrics.record_unsched_wait",
+        "SchedulerMetrics.record_lifecycle_dropped",
+        "SchedulerMetrics.record_trace_dropped",
+        "SchedulerMetrics.record_predicted_bytes",
         "SchedulerMetrics.record_round",
         "SchedulerMetrics.record_degrade",
         "SchedulerMetrics.record_express_batch",
@@ -223,6 +244,17 @@ DEFAULT_CONTRACTS = Contracts(
             "capture_snapshot",
             "CheckpointManager.capture",
         ),
+        # the shadow audit's capture (obs/audit.py) runs on the
+        # driver thread at the sampling cadence: list/array copies of
+        # host data only, never a device sync. Like the checkpoint
+        # capture it is deliberately NOT an O(churn) scope — the
+        # amortized-cadence O(cluster) copy is its documented design
+        # (the audit WORKER runs on its own background thread, off
+        # every hot path, and is deliberately unlisted)
+        "poseidon_tpu/obs/audit.py": (
+            "ShadowAuditor.due",
+            "ShadowAuditor.capture",
+        ),
         # observability recording + span assembly (_OBS_HOT_SCOPES):
         # pure host arithmetic on values the caller already fetched,
         # never a new device sync
@@ -346,7 +378,12 @@ DEFAULT_CONTRACTS = Contracts(
         # ObsServer attributes (the former ``_httpd`` handoff entry was
         # PTA006-audited stale: no background context reads the
         # attribute — the serving thread holds the httpd OBJECT via
-        # Thread(target=), it never dereferences ``self._httpd``)
+        # Thread(target=), it never dereferences ``self._httpd``).
+        # ``slo`` IS read per /slo request by handler threads, via a
+        # captured server reference the lockset pass cannot attribute
+        # — the benign-race rationale (atomic reference assignment; a
+        # stale read costs one 404 scrape) is documented at the read
+        # site in obs/server.py
         "ObsServer": ThreadContract(lock_attr="_lock", handoffs={}),
         # the checkpoint manager (ha/checkpoint.py): capture on the
         # driver thread, serialization on the background writer; the
@@ -363,6 +400,19 @@ DEFAULT_CONTRACTS = Contracts(
         "ActuationJournal": ThreadContract(
             lock_attr="_lock", handoffs={}
         ),
+        # the shadow auditor (obs/audit.py): capture on the driver
+        # thread, the re-solve on the audit worker; the snapshot
+        # handoff is a bounded queue.Queue of immutable-after-capture
+        # snapshots, and results/counters are written and read under
+        # _lock on both sides
+        # (the snapshot handoff is a queue.Queue — construction-only
+        # attribute, so no handoff entry is needed: the queue's own
+        # lock is the happens-before edge)
+        "ShadowAuditor": ThreadContract(lock_attr="_lock"),
+        # the SLO engine: evaluate() on the driver thread, status()
+        # on the obs server's handler threads — window state is read
+        # and written under _lock on both sides
+        "SloEngine": ThreadContract(lock_attr="_lock", handoffs={}),
         # watch.py's per-resource reader thread (the former ``rv``
         # handoff entry was PTA006-audited stale: the reconnect cursor
         # is reader-thread-private — construction aside, no main-thread

@@ -290,6 +290,8 @@ class SchedulerBridge:
         profile_spans: bool = False,
         solver=None,
         flightrec=None,
+        lifecycle=None,
+        auditor=None,
     ):
         self.cost_model = cost_model
         self.max_tasks_per_machine = max_tasks_per_machine
@@ -314,6 +316,17 @@ class SchedulerBridge:
         # FETCH_TIMEOUT / resync-storm or on demand. None = off, zero
         # cost.
         self.flightrec = flightrec
+        # the quality observatory (obs/lifecycle.py, obs/audit.py):
+        # ``lifecycle`` stamps per-pod timelines at every stage the
+        # bridge drives (event/decided/confirmed — the cli stamps the
+        # journal/POST stages it owns); ``auditor`` captures a sampled
+        # cluster snapshot per cadence for the background shadow
+        # re-solve. Both None = off, zero cost.
+        self.lifecycle = lifecycle
+        self.auditor = auditor
+        # trace-ring overwrites already mirrored into the metrics
+        # counter (per-round delta against trace.dropped_total)
+        self._trace_drops_seen = 0
         # the watch stream position recorded with each round's flight
         # record (driver-set: cli stamps ClusterWatcher.applied_rv per
         # tick; "" = poll mode / no driver stamp)
@@ -555,6 +568,11 @@ class SchedulerBridge:
                 log.info("new pending pod %s", pod.uid)
                 self.trace.emit("SUBMIT", task=pod.uid,
                                 round_num=self.round_num)
+                if self.lifecycle is not None:
+                    # timeline zero: first sight of schedulable work
+                    # (the express path backdates to the watch
+                    # dequeue stamp when the driver has one)
+                    self.lifecycle.stamp_event(pod.uid)
                 self.tasks[pod.uid] = pod
                 if g:
                     g.note_task_added(pod)
@@ -659,6 +677,8 @@ class SchedulerBridge:
                 self.tasks.pop(pod.uid, None)
                 self.pod_to_machine.pop(pod.uid, None)
                 self.knowledge.retire_task(pod.uid)
+                if self.lifecycle is not None:
+                    self.lifecycle.drop(pod.uid)
         return pod.uid
 
     def _remove_pod(self, uid: str) -> None:
@@ -670,6 +690,8 @@ class SchedulerBridge:
             self._retire_notes(task)
         self.pod_to_machine.pop(uid, None)
         self.knowledge.retire_task(uid)
+        if self.lifecycle is not None:
+            self.lifecycle.drop(uid)
 
     def observe_pods(self, pods: list[Task]) -> None:
         """The reference's per-pod dispatch (scheduler_bridge.cc:132-162),
@@ -716,6 +738,16 @@ class SchedulerBridge:
         tick; they surface in the next round's ``SchedulerStats``."""
         self._watch_resyncs += resyncs
         self._watch_reconnects += reconnects
+
+    def _note_trace_drops(self) -> None:
+        """Mirror the trace ring's overwrite count into the metrics
+        counter (per-round delta; zero increments are free)."""
+        drops = self.trace.dropped_total
+        if drops != self._trace_drops_seen:
+            self.metrics.record_trace_dropped(
+                drops - self._trace_drops_seen
+            )
+            self._trace_drops_seen = drops
 
     def flight_dump(
         self, reason: str = "manual", label: str = ""
@@ -837,6 +869,11 @@ class SchedulerBridge:
                 before[pod.uid] = self.tasks.get(pod.uid)
         for typ, pod in pod_events:
             self.observe_pod_event(typ, pod)
+        if self.lifecycle is not None and t_events is not None:
+            # per-event watch receipt stamps precede the observe that
+            # minted the timelines: backdate (earliest wins)
+            for (_typ, pod), ts in zip(pod_events, t_events):
+                self.lifecycle.backdate_event(pod.uid, ts)
         if not self.express_lane:
             return None
         if not self.solver.express_ready or self._inflight is not None:
@@ -941,6 +978,8 @@ class SchedulerBridge:
             bindings[uid] = machine
             self._express_placed[uid] = machine
             self._express_unconfirmed.add(uid)
+            if self.lifecycle is not None:
+                self.lifecycle.stamp_decided(uid, "express")
             self.decision_log.append((
                 self.round_num, "PLACE", uid,
                 {"machine": machine, "express": True},
@@ -1100,6 +1139,26 @@ class SchedulerBridge:
                 float(np.percentile(lat, 99)), 3
             )
             self._express_e2b = []
+        if (
+            self.auditor is not None
+            and self.machines and self.tasks
+            and self.auditor.due(self.round_num)
+        ):
+            # the shadow audit's sampled capture: post-observe cluster
+            # state handed to the background re-solve (PTA001 hot
+            # scope on the auditor side; the O(cluster) list copy
+            # amortizes over the sampling cadence like the checkpoint
+            # capture). Captured BEFORE the build so empty rounds —
+            # a drifted place-only cluster with nothing pending rounds
+            # empty forever — still get audited.
+            self.auditor.capture(
+                round_num=self.round_num,
+                cost_model=self.cost_model,
+                hysteresis=self.migration_hysteresis,
+                machines=self.machines,
+                tasks=self.tasks,
+                knowledge=self.knowledge,
+            )
         t_start = time.perf_counter()
 
         cluster = self.cluster_state()
@@ -1129,6 +1188,7 @@ class SchedulerBridge:
                 # empty rounds still carry the window's counters
                 # (evictions, watch resyncs, express activity)
                 self.metrics.record_round(stats)
+                self._note_trace_drops()
             return InflightRound(
                 stats=stats,
                 result=RoundResult(bindings={}, stats=stats,
@@ -1344,9 +1404,13 @@ class SchedulerBridge:
 
         bindings: dict[str, str] = {}
         unscheduled: list[str] = []
+        unsched_ages: list[int] = []
         migrations: dict[str, tuple[str, str]] = {}
         preemptions: dict[str, str] = {}
         g = self._graph
+        # lifecycle lane for round-path decisions: the service lane's
+        # per-tenant sessions stamp "service", everything else "tick"
+        lc_lane = "service" if self.lane == "service" else "tick"
 
         def _age(uid: str, task: Task) -> None:
             # aging: parked pods push harder next round (the
@@ -1357,6 +1421,7 @@ class SchedulerBridge:
             if g:
                 g.note_task_aged(uid)
             unscheduled.append(uid)
+            unsched_ages.append(task.wait_rounds + 1)
 
         def _live_pending(uid: str) -> Task | None:
             task = self.tasks.get(uid)
@@ -1385,6 +1450,8 @@ class SchedulerBridge:
                 _age(d.task, task)
                 continue
             bindings[d.task] = d.machine
+            if self.lifecycle is not None:
+                self.lifecycle.stamp_decided(d.task, lc_lane)
             self.decision_log.append((
                 self.round_num, "PLACE", d.task,
                 {"machine": d.machine, "cost": d.cost,
@@ -1496,8 +1563,13 @@ class SchedulerBridge:
             detail=dataclasses.asdict(stats),
         )
         self.trace.flush()
+        if self.lifecycle is not None:
+            # the standing-unscheduled wait-age surface (the ages the
+            # _age walk above already collected — no second walk)
+            self.lifecycle.note_unscheduled(unsched_ages)
         if self.metrics is not None:
             self.metrics.record_round(stats)
+            self._note_trace_drops()
         if self.flightrec is not None:
             self.flightrec.capture_finish(
                 ir.flight, outcome, dataclasses.asdict(stats),
@@ -1637,6 +1709,10 @@ class SchedulerBridge:
                     g.note_slots_changed(machine, +1)
         self.tasks[uid] = stored
         self.pod_to_machine[uid] = machine
+        if self.lifecycle is not None:
+            # the lifecycle close: event -> confirmed, recorded under
+            # the lane stamped at decision time
+            self.lifecycle.close_confirmed(uid)
         if self.express_lane:
             # the bound pod leaves the pending set: queue the on-HBM
             # retire (row deactivates, seat becomes used capacity) for
@@ -1658,6 +1734,11 @@ class SchedulerBridge:
             task, phase=TaskPhase.PENDING, machine=""
         )
         self.pod_to_machine.pop(uid, None)
+        if self.lifecycle is not None:
+            # the optimistic confirm already closed the timeline:
+            # reopen it from its ORIGINAL event stamp so the pod's
+            # real end-to-end wait is measured when it finally binds
+            self.lifecycle.reopen(uid)
         if self._graph:
             self._graph.note_full_rebuild("binding revoked")
         if self.express_lane:

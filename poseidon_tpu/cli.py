@@ -300,6 +300,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "the last captured round (the on-call's 'why "
                         "did X land on Y' / 'why is Z still pending' "
                         "answer)")
+    # the quality observatory (poseidon_tpu/obs/, README "Quality &
+    # SLOs"): per-pod lifecycle tracing rides --metrics_port for free;
+    # the shadow audit re-solves a sampled cluster snapshot on a
+    # background thread (CPU-pinned pricing + the subprocess oracle —
+    # never the accelerator) and publishes placement regret vs the
+    # certified optimum; the SLO engine evaluates declarative
+    # objectives with multi-window burn rates and latched SLO_BREACH
+    # alerting
+    p.add_argument("--audit_every", type=int, default=0,
+                   help="shadow-audit the live placement every N "
+                        "rounds on a background thread (regret vs "
+                        "certified optimum, fragmentation index, "
+                        "drift; poseidon_audit_* metrics); 0 = off")
+    p.add_argument("--slo", default="",
+                   help="comma-separated SLO objectives (grammar: "
+                        "'<source> <op> <threshold> [by label=value]' "
+                        "— e.g. 'e2b_p99_ms < 10 by lane=express, "
+                        "regret == 0, ready'); evaluated per round "
+                        "with multi-window burn rates, surfaced as "
+                        "poseidon_slo_* metrics, /slo, and SLO_BREACH "
+                        "trace events. Needs --metrics_port")
+    p.add_argument("--slo_short_window", type=int, default=6,
+                   help="SLO burn-rate short window, in completed "
+                        "rounds (detection speed)")
+    p.add_argument("--slo_long_window", type=int, default=60,
+                   help="SLO burn-rate long window, in completed "
+                        "rounds (sustained-burn confirmation)")
+    p.add_argument("--slo_burn_threshold", type=float, default=1.0,
+                   help="burn rate both windows must exceed to trip "
+                        "the breach latch (1.0 = budget exhausts "
+                        "within the window)")
     p.add_argument("--flight_max_dumps", type=int, default=16,
                    help="keep only the N most recent flight-recorder "
                         "dumps in --flight_dir (oldest-first GC, so a "
@@ -530,6 +561,26 @@ def run_loop(
             args.flight_dir, metrics=sched_metrics,
             max_dumps=args.flight_max_dumps,
         )
+    # the quality observatory: lifecycle tracing + compile-latency
+    # telemetry ride the metrics surface for free; the shadow audit
+    # and the SLO engine are opt-in flags
+    lifecycle = None
+    compile_sink_set = False
+    if sched_metrics is not None:
+        from poseidon_tpu.guards import set_compile_duration_sink
+        from poseidon_tpu.obs import LifecycleTracker
+
+        lifecycle = LifecycleTracker(sched_metrics)
+        compile_sink_set = set_compile_duration_sink(
+            sched_metrics.record_compile
+        )
+    auditor = None
+    if args.audit_every > 0:
+        from poseidon_tpu.obs import ShadowAuditor
+
+        auditor = ShadowAuditor(
+            metrics=sched_metrics, sample_every=args.audit_every,
+        )
     # crash safety (--checkpoint_dir): the checkpoint manager + the
     # write-ahead actuation journal live side by side in one directory
     ckpt_mgr = None
@@ -561,7 +612,33 @@ def run_loop(
         metrics=sched_metrics,
         profile_spans=args.trace_profile == "true",
         flightrec=flightrec,
+        lifecycle=lifecycle,
+        auditor=auditor,
     )
+    # the SLO engine reads its sources from the metrics registry and
+    # emits SLO_BREACH into the bridge's trace stream
+    slo_engine = None
+    if args.slo:
+        if sched_metrics is None:
+            log.warning(
+                "--slo needs --metrics_port (the objectives read "
+                "their sources from the metrics registry); SLO "
+                "engine disabled"
+            )
+        else:
+            from poseidon_tpu.obs import SloEngine
+
+            slo_engine = SloEngine(
+                [s for s in
+                 (p.strip() for p in args.slo.split(",")) if s],
+                metrics=sched_metrics,
+                trace=bridge.trace,
+                short_window=args.slo_short_window,
+                long_window=args.slo_long_window,
+                burn_threshold=args.slo_burn_threshold,
+            )
+            if obs_server is not None:
+                obs_server.slo = slo_engine
     incremental = args.run_incremental_scheduler == "true"
     pipelined = args.round_pipeline == "true"
     stats_fh = open(args.stats_json, "a") if args.stats_json else None
@@ -639,6 +716,7 @@ def run_loop(
         outcomes = replay_journal(
             client, journal.incomplete(), journal=journal,
             trace=bridge.trace, metrics=sched_metrics,
+            lifecycle=lifecycle,
         )
         if any(outcomes.values()):
             log.info("journal replay outcomes: %s", {
@@ -726,14 +804,24 @@ def run_loop(
         return True
 
     def _bind_seqs(bindings: dict[str, str]) -> dict:
-        """Journal bind intents (one fsync) BEFORE any POST/confirm."""
+        """Journal bind intents (one fsync) BEFORE any POST/confirm.
+        Each intent carries the pod's lifecycle event stamp (wall µs)
+        so a restart replay closes the pre-crash timeline."""
         if journal is None or not bindings:
             return {}
-        return journal.intents(
-            [{"op": "bind", "uid": u, "machine": m}
+        seqs = journal.intents(
+            [{"op": "bind", "uid": u, "machine": m,
+              "t_event_us": (
+                  lifecycle.event_wall_us(u)
+                  if lifecycle is not None else 0
+              )}
              for u, m in bindings.items()],
             bridge.round_num,
         )
+        if lifecycle is not None:
+            for uid in bindings:
+                lifecycle.stamp(uid, "journal")
+        return seqs
 
     def _rebal_seqs(migrations, preemptions) -> dict:
         if journal is None or not (migrations or preemptions):
@@ -748,6 +836,11 @@ def run_loop(
         return journal.intents(ops, bridge.round_num)
 
     def _mark_bind(seqs, uid, ok) -> None:
+        if lifecycle is not None and ok:
+            # stamped on the driver thread as each pool result is
+            # consumed (the tracker is driver-thread-only); a no-op
+            # for timelines the optimistic confirm already closed
+            lifecycle.stamp(uid, "posted")
         if journal is not None and seqs:
             seq = seqs.get(("bind", uid), 0)
             (journal.confirmed if ok else journal.failed)(seq)
@@ -920,6 +1013,15 @@ def run_loop(
             # landed — proven-empty counts (the latch updates the
             # poseidon_ready gauge itself)
             health.mark_round(result.stats.backend)
+        if sched_metrics is not None:
+            # live device memory next to the budget guard's
+            # prediction (CPU backends publish nothing); allocator
+            # bookkeeping, outside the round window by design
+            sched_metrics.record_live_hbm()
+        if slo_engine is not None:
+            # one SLO evaluation per completed round (the burn-rate
+            # windows are measured in rounds)
+            slo_engine.evaluate(result.stats.round_num)
         rounds += 1
         if ckpt_mgr is not None:
             ckpt_mgr.record_age()
@@ -1062,6 +1164,15 @@ def run_loop(
     finally:
         if watcher is not None:
             watcher.stop()
+        if auditor is not None:
+            auditor.stop()
+        if compile_sink_set:
+            # the sink slot is process-global: a later run_loop in
+            # this process must not keep feeding (and keeping alive)
+            # this run's registry
+            from poseidon_tpu.guards import set_compile_duration_sink
+
+            set_compile_duration_sink(None)
         if ckpt_mgr is not None:
             # the final checkpoint: whatever warm state the daemon
             # held at exit survives to the next boot (or the standby)

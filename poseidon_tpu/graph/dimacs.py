@@ -24,14 +24,27 @@ from poseidon_tpu.graph.network import FlowNetwork
 
 def write_dimacs(net: FlowNetwork) -> str:
     h = net.to_host()
-    n_nodes = int(net.n_nodes)
-    n_arcs = int(net.n_arcs)
+    return write_dimacs_host(
+        h["src"], h["dst"], h["cap"], h["cost"], h["supply"],
+        int(net.n_nodes), int(net.n_arcs),
+    )
+
+
+def write_dimacs_host(
+    src, dst, cap, cost, supply, n_nodes: int, n_arcs: int
+) -> str:
+    """Render a DIMACS min-cost instance from HOST arrays directly.
+
+    The device-free twin of ``write_dimacs``: callers that never built
+    a ``FlowNetwork`` (the shadow audit's background thread prices on
+    host numpy and solves on the subprocess oracle) render from the
+    builder's raw arrays — no jax import, no device traffic.
+    """
     out = io.StringIO()
     out.write(f"p min {n_nodes} {n_arcs}\n")
-    supply = h["supply"]
+    supply = np.asarray(supply)
     for v in np.flatnonzero(supply):
         out.write(f"n {v + 1} {int(supply[v])}\n")
-    src, dst, cap, cost = h["src"], h["dst"], h["cap"], h["cost"]
     for a in range(n_arcs):
         out.write(
             f"a {int(src[a]) + 1} {int(dst[a]) + 1} 0 "

@@ -71,14 +71,38 @@ _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _counter_lock = threading.Lock()
 _active_counters: list["CompileCounter"] = []
 _listener_installed = False
+# optional duration sink (obs satellite): the same monitoring event
+# carries the compile's duration in seconds — a daemon wires this to
+# SchedulerMetrics.record_compile so every XLA compile lands in the
+# poseidon_xla_compile_ms histogram. One process-global slot (the
+# listener itself is process-global and cannot be unregistered).
+_duration_sink = None
 
 
-def _on_event(name: str, *_args, **_kw) -> None:
+def set_compile_duration_sink(sink) -> bool:
+    """Install a ``fn(duration_ms: float)`` receiving every XLA
+    backend compile's latency (None to clear). Returns False when
+    this jax has no monitoring hook."""
+    global _duration_sink
+    if not _install_listener():
+        return False
+    with _counter_lock:
+        _duration_sink = sink
+    return True
+
+
+def _on_event(name: str, *args, **_kw) -> None:
     if name != _COMPILE_EVENT:
         return
     with _counter_lock:
         for c in _active_counters:
             c.count += 1
+        sink = _duration_sink
+    if sink is not None and args:
+        try:
+            sink(float(args[0]) * 1000.0)
+        except Exception:  # a metrics failure must not break compiles
+            pass
 
 
 def _install_listener() -> bool:

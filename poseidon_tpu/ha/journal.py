@@ -69,6 +69,11 @@ class JournalEntry:
     from_machine: str = ""   # evict/migrate source
     round_num: int = 0
     phase: str = "intent"    # intent | posted | confirmed | failed
+    # lifecycle seed (obs/lifecycle.py): WALL µs of the pod's event
+    # receipt, journaled with the intent so a restart-replayed bind
+    # closes its PRE-CRASH timeline instead of minting a new one
+    # (monotonic clocks do not survive the process). 0 = not stamped.
+    t_event_us: int = 0
 
 
 class ActuationJournal:
@@ -132,6 +137,9 @@ class ActuationJournal:
                     "machine": op.get("machine", ""),
                     "from": op.get("from", ""),
                     "round": round_num, "t": time.time(),
+                    # wall-µs lifecycle event stamp (0 = untracked):
+                    # the cross-restart e2c seed
+                    "t_event_us": int(op.get("t_event_us", 0)),
                 }) + "\n")
             self._fh.flush()
             if self.fsync:
@@ -206,6 +214,7 @@ class ActuationJournal:
                         "seq": e.seq, "phase": "intent", "op": e.op,
                         "uid": e.uid, "machine": e.machine,
                         "from": e.from_machine, "round": e.round_num,
+                        "t_event_us": e.t_event_us,
                     }) + "\n")
                     if e.phase == "posted":
                         fh.write(json.dumps({
@@ -295,6 +304,7 @@ def incomplete_entries(path: str) -> list[JournalEntry]:
                 machine=doc.get("machine", ""),
                 from_machine=doc.get("from", ""),
                 round_num=int(doc.get("round", 0)),
+                t_event_us=int(doc.get("t_event_us", 0)),
             )
         elif seq in entries:
             entries[seq].phase = phase
@@ -306,7 +316,7 @@ def incomplete_entries(path: str) -> list[JournalEntry]:
 
 def replay_journal(
     client, entries: list[JournalEntry], *, journal=None,
-    trace=None, metrics=None,
+    trace=None, metrics=None, lifecycle=None,
 ) -> dict[str, int]:
     """Re-issue incomplete actuations idempotently (restart path).
 
@@ -335,6 +345,14 @@ def replay_journal(
             "replayed", "already-applied", "stale"
         ):
             journal.confirmed(e.seq)
+        if (
+            lifecycle is not None and e.op == "bind"
+            and outcome in ("replayed", "already-applied")
+        ):
+            # the pre-crash timeline closes here: e2c measured from
+            # the journaled wall stamp under lane="restart"
+            # (obs/lifecycle.py's documented clock-contract exception)
+            lifecycle.close_replayed(e.uid, e.t_event_us)
         if trace is not None:
             trace.emit(
                 "JOURNAL_REPLAY", task=e.uid, machine=e.machine,

@@ -36,21 +36,40 @@ surface, in three pieces:
   ``python -m poseidon_tpu.obs.replay`` re-runs a dump through the
   real solve path offline and asserts bit-identity with the recorded
   assignment/cost, reporting divergence instead of crashing.
+- ``lifecycle`` + ``audit`` + ``slo``: the quality observatory
+  (README "Quality & SLOs"). ``lifecycle`` stamps bounded per-pod
+  timelines across the tick/express/service/restart lanes and closes
+  them into true event-to-confirmed latency histograms plus a
+  standing-unscheduled wait-age distribution; ``audit`` re-solves a
+  sampled cluster snapshot on a background thread (CPU-pinned
+  pricing + the subprocess oracle — never the accelerator) and
+  publishes placement regret vs the certified optimum, a
+  fragmentation index per SKU class, and drift counts; ``slo``
+  evaluates declarative objectives (``e2b_p99_ms < 10 by
+  lane=express``, ``regret == 0``, ``ready``) with multi-window
+  burn rates, latched ``SLO_BREACH`` trace events, and the ``/slo``
+  endpoint.
 """
 
+from poseidon_tpu.obs.audit import ShadowAuditor
 from poseidon_tpu.obs.flightrec import FlightRecorder
+from poseidon_tpu.obs.lifecycle import LifecycleTracker
 from poseidon_tpu.obs.metrics import (
     MetricsRegistry,
     SchedulerMetrics,
     build_info,
 )
 from poseidon_tpu.obs.server import HealthState, ObsServer
+from poseidon_tpu.obs.slo import SloEngine
 
 __all__ = [
     "FlightRecorder",
     "HealthState",
+    "LifecycleTracker",
     "MetricsRegistry",
     "ObsServer",
     "SchedulerMetrics",
+    "ShadowAuditor",
+    "SloEngine",
     "build_info",
 ]

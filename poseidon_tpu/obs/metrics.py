@@ -30,7 +30,10 @@ prove the surface costs <2% of a flagship churned-warm round.
 from __future__ import annotations
 
 import collections
+import math
 import threading
+
+from poseidon_tpu.obs.lifecycle import bounded_lane
 
 # Default latency buckets (milliseconds): spans sub-ms express repairs
 # through multi-second degraded rounds. One shared tuple — the bucket
@@ -46,6 +49,21 @@ E2B_BUCKETS_MS = (
     0.25, 0.5, 1.0, 2.0, 3.0, 5.0, 8.0, 13.0, 25.0, 50.0, 100.0, 250.0,
 )
 
+# Lifecycle event-to-confirmed buckets: the tick lane waits for a
+# round (polling periods are seconds), the express lane binds in
+# single-digit ms, the restart lane spans a process death — one set
+# covers ms through minutes.
+E2C_BUCKETS_MS = (
+    1.0, 5.0, 10.0, 25.0, 100.0, 500.0, 1000.0, 5000.0, 15_000.0,
+    60_000.0, 300_000.0,
+)
+
+# XLA compile latency buckets (ms): warmup compiles run 100ms-10s+
+COMPILE_BUCKETS_MS = (
+    10.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+    10_000.0, 30_000.0,
+)
+
 
 def _labelkey(labels: dict) -> tuple:
     """Canonical hashable key for one labelset."""
@@ -53,8 +71,15 @@ def _labelkey(labels: dict) -> tuple:
 
 
 def _fmt_value(v: float) -> str:
-    """Prometheus sample value: integers render without the '.0'."""
+    """Prometheus sample value: integers render without the '.0';
+    non-finite values use the exposition format's spellings (an inf
+    gauge — e.g. an SLO percentile beyond the top histogram bucket —
+    must not crash every subsequent scrape)."""
     f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
     return str(int(f)) if f == int(f) else repr(f)
 
 
@@ -109,6 +134,12 @@ class Gauge(_Instrument):
         with self._lock:
             self._values[_labelkey(labels)] = float(value)
 
+    def snapshot(self) -> dict[tuple, float]:
+        """Labelset -> value copy under the lock (the SLO engine's
+        read surface)."""
+        with self._lock:
+            return dict(self._values)
+
     def _render(self, out: list[str]) -> None:
         for key, v in sorted(self._values.items()):
             out.append(f"{self.name}{_fmt_labels(key)} {_fmt_value(v)}")
@@ -139,6 +170,15 @@ class Histogram(_Instrument):
                     counts[i] += 1
             slot[1] += value
             slot[2] += 1
+
+    def snapshot(self) -> dict[tuple, tuple]:
+        """Labelset -> (bucket counts copy, sum, count) under the
+        lock — the SLO engine's windowed-burn read surface."""
+        with self._lock:
+            return {
+                key: (list(counts), total, n)
+                for key, (counts, total, n) in self._values.items()
+            }
 
     def _render(self, out: list[str]) -> None:
         for key, (counts, total, n) in sorted(self._values.items()):
@@ -245,6 +285,52 @@ def resync_reason_label(reason: str) -> str:
     if "unparseable" in reason or "undecodable" in reason:
         return "decode"
     return "error"
+
+
+# every label value a record_* helper mints is folded onto one of
+# these bounded vocabularies BEFORE it reaches an instrument — an
+# out-of-vocabulary value becomes "other", never a fresh series
+# (unbounded label churn is how a metrics endpoint ODs its scraper;
+# tests/test_observatory.py fuzzes every fold)
+
+# driver lane compositions (cli builds "watch+pipelined+sharded"...)
+_LANE_PARTS = frozenset({
+    "poll", "watch", "express", "pipelined", "sharded", "agg",
+    "round", "service", "bench",
+})
+
+_DEGRADE_WHYS = frozenset({
+    "memory-envelope", "cost-domain", "uncertified", "kernel-envelope",
+    "general-unconverged", "general-infeasible", "general-guard",
+    "small-instance", "not-scheduling-shaped",
+})
+
+_BUILD_MODES = frozenset({"delta", "full", "legacy", "none"})
+
+_RESOURCES = frozenset({"nodes", "pods"})
+
+
+def lane_label(lane: str) -> str:
+    """Fold a driver lane composition onto the bounded vocabulary:
+    every '+'-part must be known, else the whole value is "other"."""
+    if not lane:
+        return "round"
+    if all(p in _LANE_PARTS for p in lane.split("+")):
+        return lane
+    return "other"
+
+
+def degrade_why_label(why: str) -> str:
+    return why if why in _DEGRADE_WHYS else "other"
+
+
+def build_mode_label(mode: str) -> str:
+    mode = mode or "none"
+    return mode if mode in _BUILD_MODES else "other"
+
+
+def resource_label(resource: str) -> str:
+    return resource if resource in _RESOURCES else "other"
 
 
 class SchedulerMetrics:
@@ -365,6 +451,94 @@ class SchedulerMetrics:
             "the /readyz latch: 1 after seed LIST + first round over "
             "real state (certified solve or proven-empty)",
         )
+        # ---- the quality observatory (obs/lifecycle|audit|slo) ----
+        self.pod_e2c = registry.histogram(
+            "poseidon_pod_e2c_ms",
+            "per-pod event-to-confirmed latency by (bounded) "
+            "lifecycle lane (tick/express/service/restart/other); "
+            "restart-lane samples are wall-differenced across the "
+            "process boundary (the documented clock-contract "
+            "exception)",
+            buckets=E2C_BUCKETS_MS,
+        )
+        self.unsched_wait = registry.gauge(
+            "poseidon_unsched_wait_rounds",
+            "wait-age distribution of STANDING unscheduled pods at "
+            "the last round, by quantile (p50/p95/max)",
+        )
+        self.lifecycle_dropped = registry.counter(
+            "poseidon_lifecycle_dropped_total",
+            "pod timelines dropped because the lifecycle tracker was "
+            "at its open-timeline bound",
+        )
+        self.trace_dropped = registry.counter(
+            "poseidon_trace_dropped_total",
+            "trace events overwritten by the bounded in-memory ring "
+            "before any flush (a post-mortem trace missing them is "
+            "partial, not complete)",
+        )
+        self.audit_regret = registry.gauge(
+            "poseidon_audit_regret",
+            "shadow audit: status-quo placement cost minus the "
+            "certified optimum of the same re-priced instance (0 = "
+            "placing optimally within the stated hysteresis)",
+        )
+        self.audit_drift = registry.gauge(
+            "poseidon_audit_drift_pods",
+            "shadow audit: running pods whose placement differs from "
+            "the audit optimum (tie-noisy; regret is the alertable "
+            "number)",
+        )
+        self.audit_frag = registry.gauge(
+            "poseidon_audit_frag_slots",
+            "shadow audit fragmentation index: largest free seat "
+            "count on any single machine, by (bounded) SKU class — "
+            "the biggest one-machine gang that could still land",
+        )
+        self.audit_ms = registry.gauge(
+            "poseidon_audit_ms",
+            "wall time of the most recent shadow audit (background "
+            "thread; not on any round's critical path)",
+        )
+        self.audit_runs = registry.counter(
+            "poseidon_audit_runs_total",
+            "completed shadow audits, by outcome (ok/error)",
+        )
+        self.slo_healthy = registry.gauge(
+            "poseidon_slo_healthy",
+            "1 while the objective's burn-rate alert is inactive, by "
+            "slo (operator-declared specs: bounded by construction)",
+        )
+        self.slo_burn = registry.gauge(
+            "poseidon_slo_burn_rate",
+            "error-budget burn rate by slo and window (short/long); "
+            ">1 sustained in both windows trips the breach latch",
+        )
+        self.slo_value = registry.gauge(
+            "poseidon_slo_value",
+            "current point value of the objective's source (display "
+            "estimate; the burn math uses exact bucket counts)",
+        )
+        self.slo_breaches = registry.counter(
+            "poseidon_slo_breaches_total",
+            "SLO breach-latch trips (exactly one per breach window), "
+            "by slo",
+        )
+        # ---- device telemetry (satellite: live HBM + compiles) ----
+        self.device_hbm = registry.gauge(
+            "poseidon_device_hbm_bytes",
+            "device memory by kind: live = the backend's own "
+            "bytes-in-use (platforms that expose memory_stats), "
+            "predicted = check_table_budget's per-device estimate "
+            "for the last dense round — the budget guard's math "
+            "cross-checked against real hardware",
+        )
+        self.xla_compile = registry.histogram(
+            "poseidon_xla_compile_ms",
+            "XLA backend compile latency (fed from the CompileCounter "
+            "monitoring seam; nonzero only during warmup/growth)",
+            buckets=COMPILE_BUCKETS_MS,
+        )
         self.flightrec_dumps = registry.counter(
             "poseidon_flightrec_dumps_total",
             "anomaly flight-recorder dumps written, by (bounded) "
@@ -434,6 +608,9 @@ class SchedulerMetrics:
         # degraded-gauge bookkeeping: whys currently set to 1, so a
         # recovery round can clear exactly what an earlier round set
         self._degraded_whys: set[str] = set()
+        # fragmentation-gauge bookkeeping: SKU labels set by the last
+        # audit, so a class that drains out of the fleet is zeroed
+        self._frag_skus: set[str] = set()
         self._resync_window: collections.deque[int] = collections.deque(
             maxlen=STORM_WINDOW
         )
@@ -443,7 +620,7 @@ class SchedulerMetrics:
     def record_round(self, stats) -> None:
         """Record one completed round from its ``SchedulerStats`` —
         every input is a host float/int the bridge already computed."""
-        lane = stats.lane or "round"
+        lane = lane_label(stats.lane)
         family = _backend_family(stats.backend)
         self.rounds.inc(lane=lane, backend=family)
         if stats.backend:
@@ -454,7 +631,7 @@ class SchedulerMetrics:
             # the trace report excludes ("no solve to time")
             self.round_latency.observe(
                 stats.total_ms, lane=lane,
-                build_mode=stats.build_mode or "none",
+                build_mode=build_mode_label(stats.build_mode),
             )
             for phase, dur in (
                 ("observe", stats.observe_ms),
@@ -489,7 +666,7 @@ class SchedulerMetrics:
         if stats.backend.startswith("oracle:"):
             w = stats.backend.split(":", 1)[1]
             if w not in _ROUTED_WHYS:
-                why = w
+                why = degrade_why_label(w)
         if why:
             self.degraded.set(1, why=why)
             self._degraded_whys.add(why)
@@ -506,7 +683,7 @@ class SchedulerMetrics:
     def record_degrade(self, why: str) -> None:
         """One non-deliberate dense-lane degrade (the DEGRADE event's
         metrics twin)."""
-        self.degrades.inc(why=why)
+        self.degrades.inc(why=degrade_why_label(why))
 
     def record_flightrec_dump(self, reason: str) -> None:
         """One flight-recorder dump written (reason is the recorder's
@@ -530,6 +707,97 @@ class SchedulerMetrics:
 
     def record_restore(self) -> None:
         self.restores.inc()
+
+    # ---- the quality observatory ---------------------------------------
+
+    def record_pod_e2c(self, e2c_ms: float, lane: str) -> None:
+        """One closed pod timeline. The tracker pre-folds its lanes;
+        the fold here keeps the PUBLIC seam bounded for any other
+        caller (module-level import — no per-call cost)."""
+        self.pod_e2c.observe(e2c_ms, lane=bounded_lane(lane))
+
+    def record_unsched_wait(
+        self, p50: float, p95: float, mx: float
+    ) -> None:
+        """The round's standing-unscheduled wait-age quantiles (host
+        floats the lifecycle tracker already computed)."""
+        self.unsched_wait.set(p50, q="p50")
+        self.unsched_wait.set(p95, q="p95")
+        self.unsched_wait.set(mx, q="max")
+
+    def record_lifecycle_dropped(self) -> None:
+        self.lifecycle_dropped.inc()
+
+    def record_trace_dropped(self, n: int) -> None:
+        """Trace-ring overwrites since the last round (bridge-reported
+        delta; zero increments are free)."""
+        self.trace_dropped.inc(n)
+
+    def record_audit(self, res) -> None:
+        """One completed shadow audit (worker thread; host ints — the
+        registry lock is the cross-thread discipline, the same pattern
+        as record_checkpoint)."""
+        self.audit_runs.inc(outcome="error" if res.error else "ok")
+        self.audit_ms.set(res.audit_ms)
+        if res.error:
+            return
+        self.audit_regret.set(res.regret)
+        self.audit_drift.set(res.drift_pods)
+        for sku, slots in res.frag_slots.items():
+            self.audit_frag.set(slots, sku=sku)
+        # a SKU class that drained out of the fleet must not keep
+        # reporting its last capacity forever: zero vanished labels
+        # (labelsets cannot be deleted, so 0 is the tombstone)
+        for sku in self._frag_skus - set(res.frag_slots):
+            self.audit_frag.set(0, sku=sku)
+        self._frag_skus = set(res.frag_slots)
+
+    def record_slo(
+        self, spec: str, *, healthy: bool, burn_short: float,
+        burn_long: float, value, breached: bool,
+    ) -> None:
+        """One objective's evaluation tick (SLO engine, driver
+        thread; ``spec`` is operator-declared, bounded by
+        construction)."""
+        self.slo_healthy.set(1 if healthy else 0, slo=spec)
+        self.slo_burn.set(burn_short, slo=spec, window="short")
+        self.slo_burn.set(burn_long, slo=spec, window="long")
+        if value is not None:
+            self.slo_value.set(value, slo=spec)
+        if breached:
+            self.slo_breaches.inc(slo=spec)
+
+    # ---- device telemetry ----------------------------------------------
+
+    def record_predicted_bytes(self, nbytes: int) -> None:
+        """The dense round's per-device table estimate (the
+        check_table_budget math; host int the solver already
+        computed)."""
+        self.device_hbm.set(nbytes, kind="predicted")
+
+    def record_live_hbm(self) -> int | None:
+        """Read the default backend's own memory stats and publish
+        bytes-in-use (platforms without memory_stats — CPU — publish
+        nothing). Called from the driver loop once per tick, never
+        inside the round window: the runtime query is allocator
+        bookkeeping, not a device sync, but it has no business on the
+        hot path either."""
+        import jax
+
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # backends without the API
+            return None
+        if not stats or "bytes_in_use" not in stats:
+            return None
+        live = int(stats["bytes_in_use"])
+        self.device_hbm.set(live, kind="live")
+        return live
+
+    def record_compile(self, duration_ms: float) -> None:
+        """One XLA backend compile (guards.py monitoring seam; may be
+        called from any thread — the registry lock covers it)."""
+        self.xla_compile.observe(duration_ms)
 
     def set_build_info(self, info: dict) -> None:
         """Publish the build-identity gauge (value 1, labels = the
@@ -560,7 +828,7 @@ class SchedulerMetrics:
         self.watch_resyncs.inc(reason=resync_reason_label(reason))
 
     def record_reconnect(self, resource: str) -> None:
-        self.watch_reconnects.inc(resource=resource)
+        self.watch_reconnects.inc(resource=resource_label(resource))
 
     # ---- resident solver ----------------------------------------------
 

@@ -81,6 +81,7 @@ def analyze_trace(path: str, *, tenant: str = "") -> dict:
                   "FLIGHTREC_DUMP")
     }
     churn = collections.Counter()
+    slo_breaches = collections.Counter()
     span_phases: dict[str, list[float]] = collections.defaultdict(list)
     rounds = 0
     nonempty_rounds = 0
@@ -120,6 +121,9 @@ def analyze_trace(path: str, *, tenant: str = "") -> dict:
             backend_lat[family].append(total)
         elif ev.event in tallies:
             tallies[ev.event][_why_of(ev.detail)] += 1
+        elif ev.event == "SLO_BREACH":
+            d = ev.detail if isinstance(ev.detail, dict) else {}
+            slo_breaches[str(d.get("slo", "unknown"))] += 1
         elif ev.event == "EXPRESS_PLACE":
             churn[ev.event] += 1
             if isinstance(ev.detail, dict) and "e2b_ms" in ev.detail:
@@ -157,6 +161,9 @@ def analyze_trace(path: str, *, tenant: str = "") -> dict:
         "degradations": {
             k: dict(c.most_common()) for k, c in tallies.items()
         },
+        # SLO breach-latch trips by objective spec (obs/slo.py emits
+        # exactly one SLO_BREACH per breach window)
+        "slo_breaches": dict(slo_breaches.most_common()),
         "churn": {
             "totals": {k: int(churn.get(k, 0)) for k in _CHURN_EVENTS},
             "per_round": {
@@ -215,6 +222,13 @@ def render_report(data: dict) -> str:
             any_deg = True
             add(f"{kind:<18}{n:>6}  {reason}")
     if not any_deg:
+        add("none")
+    add("")
+    add("-- SLO breaches (latch trips by objective) --")
+    if data.get("slo_breaches"):
+        for spec, n in data["slo_breaches"].items():
+            add(f"{n:>4}  {spec}")
+    else:
         add("none")
     add("")
     ch = data["churn"]

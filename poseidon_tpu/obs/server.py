@@ -1,4 +1,4 @@
-"""The metrics/health HTTP endpoint: /metrics, /healthz, /readyz.
+"""The metrics/health HTTP endpoint: /metrics, /healthz, /readyz, /slo.
 
 A ``ThreadingHTTPServer`` on a daemon thread (one short-lived handler
 thread per scrape; the registry's shared lock makes renders safe
@@ -30,6 +30,11 @@ the discipline). Endpoints:
   readiness failures — they surface as labeled gauges
   (``poseidon_degraded{why=...}``, ``poseidon_watch_resync_storm``)
   since a degraded scheduler is still scheduling.
+
+- ``GET /slo``: the SLO engine's evaluation state (obs/slo.py) as
+  JSON — per objective: spec, healthy, short/long burn rates, breach
+  count, current value. 404 when no ``--slo`` objectives were
+  declared.
 
 ``HealthState`` is the driver-fed latch behind ``/readyz``; the cli
 marks it from the observe/round loop (cli.py), tests drive it
@@ -140,6 +145,7 @@ class ObsServer:
         port: int = 0,
         host: str = "0.0.0.0",
         build: dict | None = None,
+        slo=None,
     ):
         self.registry = registry
         self.health = health
@@ -148,12 +154,16 @@ class ObsServer:
         # the /healthz build-identity echo (obs.metrics.build_info());
         # immutable after start, so handler threads read it lock-free
         self.build = dict(build or {})
+        # the SLO engine behind /slo (obs/slo.py; None = 404):
+        # status() serves handler threads under the engine's own lock
+        self.slo = slo
         self._httpd: http.server.ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     def start(self) -> int:
         registry = self.registry
         health = self.health
+        srv = self  # handlers read srv.slo PER REQUEST (below)
         healthz_body = json.dumps(
             {"status": "ok", "build": self.build}
         ).encode() + b"\n"
@@ -175,6 +185,27 @@ class ObsServer:
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/json")
+                elif route == "/slo":
+                    # read per request, not captured at start():
+                    # drivers assign server.slo by attribute and must
+                    # not need to order that before start() (reference
+                    # assignment is atomic; a stale read costs one
+                    # 404 scrape, never a crash)
+                    slo_engine = srv.slo
+                    if slo_engine is None:
+                        body = (
+                            b"no SLO engine configured (--slo)\n"
+                        )
+                        self.send_response(404)
+                        self.send_header("Content-Type",
+                                         "text/plain")
+                    else:
+                        body = json.dumps(
+                            slo_engine.status(), indent=1
+                        ).encode() + b"\n"
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
                 elif route == "/readyz":
                     if health.ready:
                         # condition detail: did this process warm-

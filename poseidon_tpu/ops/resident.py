@@ -83,6 +83,7 @@ from poseidon_tpu.ops.dense_auction import (
     DenseInstance,
     DenseMemoryTooLarge,
     DenseState,
+    _budget_need,
     _densify,
     _solve,
     check_table_budget,
@@ -1311,6 +1312,14 @@ class ResidentSolver:
                 "degrading to oracle", e,
             )
             return degrade("memory-envelope", base_topo)
+        if self.metrics is not None:
+            # the budget guard's per-device estimate, published next
+            # to the backend's LIVE bytes-in-use (cli records that
+            # side): the predicted-vs-real HBM cross-check. Pure host
+            # arithmetic — the same _budget_need the guard just ran.
+            self.metrics.record_predicted_bytes(_budget_need(
+                Tp, Mp, 1, 0, 0, max(self.mesh_width, 1)
+            ))
         self._t_floor = Tp
         self._m_floor = Mp
         # power-of-two smax bound: top_k cost grows mildly with smax but

@@ -1,3 +1,7 @@
-from poseidon_tpu.oracle.oracle import OracleResult, solve_oracle
+from poseidon_tpu.oracle.oracle import (
+    OracleResult,
+    solve_dimacs,
+    solve_oracle,
+)
 
-__all__ = ["OracleResult", "solve_oracle"]
+__all__ = ["OracleResult", "solve_dimacs", "solve_oracle"]

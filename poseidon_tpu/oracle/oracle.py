@@ -73,8 +73,27 @@ def solve_oracle(
 ) -> OracleResult:
     """Solve ``net`` exactly on CPU. ``timeout_s`` mirrors the reference's
     --max_solver_runtime ceiling (1000 s, poseidon.cfg:14-15)."""
+    return solve_dimacs(
+        write_dimacs(net), int(net.n_arcs),
+        algorithm=algorithm, timeout_s=timeout_s,
+    )
+
+
+def solve_dimacs(
+    text: str,
+    n_arcs: int,
+    *,
+    algorithm: str = "ssp",
+    timeout_s: float = 1000.0,
+) -> OracleResult:
+    """Solve an already-rendered DIMACS instance on the CPU binary.
+
+    The device-free entry point: callers that hold only HOST arrays
+    (the shadow audit's background thread, obs/audit.py) render via
+    ``graph.dimacs.write_dimacs_host`` and never construct a
+    ``FlowNetwork`` — no jax, no device, just a subprocess.
+    """
     binary = _ensure_built()
-    text = write_dimacs(net)
     try:
         proc = subprocess.run(
             [str(binary), algorithm],
@@ -95,7 +114,7 @@ def solve_oracle(
         raise RuntimeError(
             f"oracle failed rc={proc.returncode}: {proc.stderr[:500]}"
         )
-    cost, flows = parse_flow_output(proc.stdout, int(net.n_arcs))
+    cost, flows = parse_flow_output(proc.stdout, n_arcs)
     solve_ms = 0.0
     for line in proc.stdout.splitlines():
         if line.startswith("c time_ms"):

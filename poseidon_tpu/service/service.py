@@ -132,6 +132,14 @@ class SchedulingService:
         # so N tenants can share one trace sink and still be
         # reported individually (trace report --tenant)
         tr.tenant = tenant_id
+        lifecycle = None
+        if self.metrics is not None:
+            # per-tenant lifecycle timelines close into the SHARED
+            # e2c histogram under lane="service" (the bridge stamps
+            # the lane from its own lane label)
+            from poseidon_tpu.obs.lifecycle import LifecycleTracker
+
+            lifecycle = LifecycleTracker(self.metrics)
         bridge = SchedulerBridge(
             cost_model=cost_model,
             max_tasks_per_machine=max_tasks_per_machine,
@@ -141,6 +149,7 @@ class SchedulingService:
             max_migrations_per_round=max_migrations_per_round,
             incremental_build=incremental_build,
             solver=solver,
+            lifecycle=lifecycle,
         )
         bridge.lane = "service"
         label = (

@@ -108,6 +108,10 @@ EVENT_TYPES = frozenset({
     "JOURNAL_REPLAY",   # an incomplete journaled actuation was
                         # replayed idempotently on restart
                         # (ha/journal.py; detail.op/outcome)
+    "SLO_BREACH",       # an SLO burn-rate alert latched (obs/slo.py;
+                        # detail.slo names the objective spec,
+                        # detail.burn_short/burn_long the rates —
+                        # emitted exactly once per breach window)
 })
 
 
@@ -147,6 +151,12 @@ class TraceGenerator:
         self.events: collections.deque[TraceEvent] = collections.deque(
             maxlen=buffer_events
         )
+        # ring-overwrite visibility: events the bounded ring dropped
+        # before anyone read them. A post-mortem against the in-memory
+        # ring must KNOW it is partial — the bridge mirrors the count
+        # into poseidon_trace_dropped_total per round, and the first
+        # overwrite warns once.
+        self.dropped_total = 0
 
     def emit(
         self,
@@ -174,6 +184,19 @@ class TraceGenerator:
         if self.sink is not None:
             self.sink.write(json.dumps(dataclasses.asdict(ev)) + "\n")
         else:
+            if (
+                self.events.maxlen is not None
+                and len(self.events) == self.events.maxlen
+            ):
+                if not self.dropped_total:
+                    log.warning(
+                        "trace ring full (%d events, no sink): "
+                        "overwriting oldest — this in-memory trace is "
+                        "now PARTIAL (counted in dropped_total / "
+                        "poseidon_trace_dropped_total)",
+                        self.events.maxlen,
+                    )
+                self.dropped_total += 1
             self.events.append(ev)
 
     def flush(self) -> None:

@@ -1,0 +1,840 @@
+"""The quality observatory: per-pod lifecycle tracing, the shadow
+placement audit, and the declarative SLO engine (ISSUE 14).
+
+Covers the acceptance surface directly:
+
+- lifecycle differential: the SAME pod's event-to-confirmed latency
+  via the tick lane and the express lane agrees with the
+  driver-observed wall time (monotonic-clock contract), and a
+  restart-replayed bind closes its PRE-CRASH timeline (wall-stamp
+  seed from the journal) instead of minting a new one;
+- shadow audit: regret is bit-zero on a certified-exact steady state,
+  measurably positive on the config-6 drift cluster (including via
+  EMPTY place-only rounds), and recovers to zero when rebalancing
+  settles;
+- SLO engine: grammar, multi-window burn rates, and the breach latch
+  firing EXACTLY once per breach window;
+- trace-ring overwrite visibility and the label-cardinality bounds
+  fuzz (out-of-vocabulary labels fold, never mint).
+"""
+
+import dataclasses
+import json
+import random
+import re
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from poseidon_tpu.bridge import SchedulerBridge, SchedulerStats
+from poseidon_tpu.cluster import Task
+from poseidon_tpu.obs import (
+    HealthState,
+    LifecycleTracker,
+    MetricsRegistry,
+    ObsServer,
+    SchedulerMetrics,
+    ShadowAuditor,
+    SloEngine,
+)
+from poseidon_tpu.obs.lifecycle import LANES, bounded_lane
+from poseidon_tpu.obs.metrics import (
+    _BUILD_MODES,
+    _DEGRADE_WHYS,
+    build_mode_label,
+    degrade_why_label,
+    lane_label,
+    resource_label,
+    resync_reason_label,
+)
+from poseidon_tpu.obs.slo import SloParseError, parse_objective
+from poseidon_tpu.synth import config6_rebalance, make_synthetic_cluster
+from poseidon_tpu.trace import TraceGenerator
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+# module-level jitted probe (PTA003: no inline jax.jit): the compile
+# telemetry test drives one backend compile through it
+import jax  # noqa: E402
+
+_COMPILE_PROBE = jax.jit(lambda x: x * 3 + 1)
+
+
+def _metrics() -> SchedulerMetrics:
+    return SchedulerMetrics(MetricsRegistry())
+
+
+def _observed_bridge(**kw):
+    m = kw.pop("metrics", None) or _metrics()
+    lc = LifecycleTracker(m)
+    br = SchedulerBridge(
+        cost_model="quincy", small_to_oracle=False, metrics=m,
+        lifecycle=lc, **kw,
+    )
+    return br, lc, m
+
+
+def _settle(br, rounds=1):
+    last = None
+    for _ in range(rounds):
+        last = br.run_scheduler()
+        for uid, mach in last.bindings.items():
+            br.confirm_binding(uid, mach)
+        for uid, (_f, to) in last.migrations.items():
+            br.confirm_migration(uid, to)
+        for uid in last.preemptions:
+            br.confirm_preemption(uid)
+    return last
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestLifecycle:
+    def test_tick_lane_e2c_matches_driver_wall(self):
+        br, lc, m = _observed_bridge()
+        c = make_synthetic_cluster(12, 20, seed=0, prefs_per_task=2)
+        br.observe_nodes(list(c.machines))
+        t0 = time.perf_counter()
+        br.observe_pods(list(c.tasks))
+        _settle(br)
+        wall_ms = (time.perf_counter() - t0) * 1000
+        assert lc.closed_total > 0
+        uid, lane, e2c = lc.last_closed
+        assert lane == "tick"
+        # the e2c clock starts at first sight (inside the observe
+        # above) and stops at confirm — it must sit inside the
+        # driver's own wall measurement of the same span
+        assert 0 < e2c <= wall_ms + 1.0
+        text = m.registry.render()
+        assert 'poseidon_pod_e2c_ms_bucket{lane="tick"' in text
+
+    def test_same_pod_tick_then_express_lanes_agree(self):
+        """The SAME uid rides the tick lane, retires, then rides the
+        express lane: each close lands in its own lane's histogram
+        and each e2c agrees with the driver-observed wall time."""
+        br, lc, m = _observed_bridge(express_lane=True)
+        c = make_synthetic_cluster(16, 30, seed=1, prefs_per_task=2)
+        br.observe_nodes(list(c.machines))
+        br.observe_pods(list(c.tasks))
+        _settle(br)
+        target = list(br.machines)[0]
+        pod = Task(uid="same-pod", cpu_request=0.1,
+                   memory_request_kb=128, data_prefs={target: 300})
+        # tick lane first
+        t0 = time.perf_counter()
+        br.observe_pod_event("ADDED", pod)
+        res = _settle(br)
+        tick_wall = (time.perf_counter() - t0) * 1000
+        assert "same-pod" in res.bindings
+        uid, lane, tick_e2c = lc.last_closed
+        assert (uid, lane) == ("same-pod", "tick")
+        assert 0 < tick_e2c <= tick_wall + 1.0
+        # retire it, then the SAME uid arrives again via express
+        br.observe_pod_event(
+            "DELETED", br.tasks["same-pod"]
+        )
+        _settle(br)  # refresh the express context
+        assert br.solver.express_ready
+        t1 = time.perf_counter()
+        out = br.express_batch(
+            [("ADDED", pod)], t_event=t1, t_events=[t1]
+        )
+        assert out is not None and "same-pod" in out.bindings
+        br.confirm_binding("same-pod", out.bindings["same-pod"])
+        express_wall = (time.perf_counter() - t1) * 1000
+        uid, lane, express_e2c = lc.last_closed
+        assert (uid, lane) == ("same-pod", "express")
+        assert 0 < express_e2c <= express_wall + 1.0
+        text = m.registry.render()
+        assert 'poseidon_pod_e2c_ms_bucket{lane="tick"' in text
+        assert 'poseidon_pod_e2c_ms_bucket{lane="express"' in text
+
+    def test_restart_replay_closes_pre_crash_timeline(self, tmp_path):
+        """A bind journaled with its lifecycle wall stamp before a
+        crash closes into lane="restart" spanning the PRE-crash wait —
+        and does not mint a fresh open timeline."""
+        from poseidon_tpu.apiclient.client import K8sApiClient
+        from poseidon_tpu.apiclient.fake_server import FakeApiServer
+        from poseidon_tpu.ha import ActuationJournal, replay_journal
+
+        path = str(tmp_path / "j.jsonl")
+        j = ActuationJournal(path)
+        pre_crash_us = int((time.time() - 5.0) * 1e6)
+        j.intents([{
+            "op": "bind", "uid": "default/p000", "machine": "n0",
+            "t_event_us": pre_crash_us,
+        }], 7)
+        j.close()
+        j2 = ActuationJournal(path)  # the restart
+        entries = j2.incomplete()
+        assert entries[0].t_event_us == pre_crash_us
+        m = _metrics()
+        lc = LifecycleTracker(m)  # fresh process: no open timelines
+        with FakeApiServer() as server:
+            server.add_node("n0", cpu="8", memory="16Gi", pods=8)
+            server.add_pod("p000", cpu="250m", memory="256Mi")
+            client = K8sApiClient("127.0.0.1", server.port)
+            out = replay_journal(
+                client, entries, journal=j2, lifecycle=lc,
+            )
+        j2.close()
+        assert out["replayed"] == 1
+        uid, lane, e2c = lc.last_closed
+        assert (uid, lane) == ("default/p000", "restart")
+        # spans the pre-crash wait (~5s), not the replay's own few ms
+        assert 4500 < e2c < 60_000
+        assert "default/p000" not in lc.open  # no new timeline minted
+        assert 'lane="restart"' in m.registry.render()
+
+    def test_unconfirmed_pod_keeps_timeline_open(self):
+        br, lc, m = _observed_bridge()
+        c = make_synthetic_cluster(8, 10, seed=2)
+        br.observe_nodes(list(c.machines))
+        br.observe_pods(list(c.tasks))
+        res = br.run_scheduler()  # decided but never confirmed
+        assert res.bindings
+        for uid in res.bindings:
+            assert uid in lc.open
+        assert lc.closed_total == 0
+
+    def test_retired_pod_drops_timeline(self):
+        br, lc, _ = _observed_bridge()
+        c = make_synthetic_cluster(8, 10, seed=2)
+        br.observe_nodes(list(c.machines))
+        br.observe_pods(list(c.tasks))
+        uid = next(iter(br.tasks))
+        assert uid in lc.open
+        br.observe_pod_event("DELETED", br.tasks[uid])
+        assert uid not in lc.open
+
+    def test_open_timeline_bound_drops_and_counts(self):
+        m = _metrics()
+        lc = LifecycleTracker(m, max_open=4)
+        for i in range(10):
+            lc.stamp_event(f"p{i}")
+        assert len(lc.open) == 4
+        assert lc.dropped == 6
+        assert "poseidon_lifecycle_dropped_total 6" in \
+            m.registry.render()
+
+    def test_backdate_event_only_moves_earlier(self):
+        lc = LifecycleTracker()
+        lc.stamp_event("p")
+        t0 = lc.open["p"].t_event
+        w0 = lc.open["p"].t_event_wall_us
+        lc.backdate_event("p", t0 - 1.0)
+        assert lc.open["p"].t_event == t0 - 1.0
+        # the wall twin (the journal's restart seed) backdates by the
+        # same delta, so a restart e2c also spans from the receipt
+        assert abs((w0 - lc.open["p"].t_event_wall_us) - 1e6) < 2e3
+        lc.backdate_event("p", t0 + 5.0)  # later: ignored
+        assert lc.open["p"].t_event == t0 - 1.0
+
+    def test_failed_post_reopens_timeline_from_original_stamp(self):
+        """The pipelined driver confirms optimistically; a failed POST
+        (binding_failed -> revoke) must REOPEN the timeline from its
+        original event stamp so the pod's real end-to-end wait is
+        still measured at the eventual successful bind."""
+        br, lc, m = _observed_bridge()
+        c = make_synthetic_cluster(8, 10, seed=2)
+        br.observe_nodes(list(c.machines))
+        br.observe_pods(list(c.tasks))
+        res = br.run_scheduler()
+        uid, mach = next(iter(res.bindings.items()))
+        t_orig = lc.open[uid].t_event
+        br.confirm_binding(uid, mach)   # optimistic (pipelined)
+        assert uid not in lc.open
+        first = lc.last_closed[2]
+        br.binding_failed(uid)          # the POST failed
+        assert uid in lc.open
+        assert lc.open[uid].t_event == t_orig
+        # the eventual successful bind spans the FULL wait
+        time.sleep(0.01)
+        res2 = br.run_scheduler()
+        assert uid in res2.bindings
+        br.confirm_binding(uid, res2.bindings[uid])
+        assert lc.last_closed[0] == uid
+        assert lc.last_closed[2] > first + 9.0
+
+    def test_stage_stamps_observable_at_close(self):
+        lc = LifecycleTracker()
+        lc.stamp_event("p")
+        lc.stamp_decided("p", "tick")
+        lc.stamp("p", "journal")
+        lc.stamp("p", "posted")
+        lc.close_confirmed("p")
+        assert set(lc.last_closed_stages) == {
+            "decided", "journal", "posted"
+        }
+
+    def test_unsched_wait_age_gauges(self):
+        br, lc, m = _observed_bridge()
+        c = make_synthetic_cluster(8, 40, seed=0)  # oversubscribed
+        br.observe_nodes(list(c.machines))
+        br.observe_pods(list(c.tasks))
+        res = _settle(br)
+        assert res.unscheduled
+        text = m.registry.render()
+        assert 'poseidon_unsched_wait_rounds{q="p50"}' in text
+        assert 'poseidon_unsched_wait_rounds{q="max"}' in text
+        # every pod the round left behind has aged at least once
+        # (synth seeds some pods with prior wait_rounds, so max >= 1)
+        mt = re.search(
+            r'poseidon_unsched_wait_rounds\{q="max"\} (\d+)', text
+        )
+        assert mt and int(mt.group(1)) >= 1
+
+
+# ---------------------------------------------------------------------------
+# shadow audit
+# ---------------------------------------------------------------------------
+
+
+class TestShadowAudit:
+    def test_regret_bit_zero_on_certified_steady_state(self):
+        aud = ShadowAuditor(sample_every=1, background=False)
+        br = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False, auditor=aud,
+        )
+        c = make_synthetic_cluster(16, 30, seed=1, prefs_per_task=2)
+        br.observe_nodes(list(c.machines))
+        br.observe_pods(list(c.tasks))
+        _settle(br)        # certified round, placements confirmed
+        br.run_scheduler()  # the next round's begin captures them
+        out = aud.run_pending()
+        assert out is not None and not out.error
+        assert out.regret == 0
+        assert out.status_quo_cost == out.optimal_cost
+        assert out.drift_pods == 0
+
+    def test_drift_cluster_regret_positive_even_on_empty_rounds(self):
+        """The config-6 drift cluster under a PLACE-ONLY bridge rounds
+        empty forever (everything is RUNNING) — the audit must still
+        fire and expose the drift as positive regret."""
+        aud = ShadowAuditor(sample_every=1, background=False)
+        br = SchedulerBridge(cost_model="quincy", auditor=aud)
+        dc = config6_rebalance(48, 120, seed=0)
+        br.observe_nodes(dc.machines)
+        br.observe_pods(dc.tasks)
+        r = br.run_scheduler()
+        assert r.stats.backend == ""  # empty round, nothing pending
+        out = aud.run_pending()
+        assert out is not None and not out.error
+        assert out.regret > 0
+        assert out.drift_pods > 0
+
+    def test_rebalancing_drives_regret_to_zero(self):
+        aud = ShadowAuditor(sample_every=1, background=False)
+        br = SchedulerBridge(
+            cost_model="quincy", enable_preemption=True,
+            migration_hysteresis=20, max_migrations_per_round=64,
+            auditor=aud,
+        )
+        dc = config6_rebalance(48, 120, seed=0)
+        br.observe_nodes(dc.machines)
+        br.observe_pods(dc.tasks)
+        regrets = []
+        for _ in range(8):
+            _settle(br)
+            out = aud.run_pending()
+            if out is not None:
+                regrets.append(out.regret)
+        assert regrets[0] > 0          # drifted at first sight
+        assert regrets[-1] == 0        # settled: promise measured
+        assert sorted(regrets, reverse=True) == regrets  # monotone
+
+    def test_fragmentation_index_bounded_sku_classes(self):
+        from poseidon_tpu.obs.audit import (
+            AuditSnapshot,
+            fragmentation_index,
+        )
+        from poseidon_tpu.cluster import Machine, TaskPhase
+
+        machines = [
+            Machine(
+                name=f"m{i}", rack="r0", cpu_capacity=float(4 + i),
+                cpu_allocatable=4.0, memory_capacity_kb=1 << 20,
+                memory_allocatable_kb=1 << 20, max_tasks=4,
+            )
+            for i in range(12)  # 12 distinct SKUs > MAX_SKU_CLASSES
+        ]
+        tasks = [
+            Task(uid="t0", cpu_request=0.1, memory_request_kb=1,
+                 phase=TaskPhase.RUNNING, machine="m0"),
+        ]
+        snap = AuditSnapshot(
+            round_num=1, cost_model="quincy", hysteresis=0,
+            machines=machines, tasks=tasks, uids=["t0"],
+            names=[m.name for m in machines],
+            task_usage=None, machine_load=None,
+            machine_mem_free=None,
+        )
+        frag = fragmentation_index(snap)
+        # content-keyed labels (stable under fleet churn), capped at
+        # MAX_SKU_CLASSES + "other"
+        assert len(frag) <= 9 and "other" in frag
+        assert frag["4c-1g-4s"] == 3  # m0 has one of four seats used
+        # stability: a new SKU joining must not remap existing labels
+        snap.machines = machines + [dataclasses.replace(
+            machines[0], name="new", cpu_capacity=1.0,
+        )]
+        frag2 = fragmentation_index(snap)
+        assert frag2["4c-1g-4s"] == 3
+
+    def test_vanished_sku_class_is_zeroed(self):
+        from poseidon_tpu.obs.audit import AuditResult
+
+        m = _metrics()
+        m.record_audit(AuditResult(
+            round_num=1, frag_slots={"8c-16g-12s": 5, "4c-8g-8s": 2},
+        ))
+        m.record_audit(AuditResult(
+            round_num=2, frag_slots={"8c-16g-12s": 4},
+        ))
+        text = m.registry.render()
+        assert 'poseidon_audit_frag_slots{sku="8c-16g-12s"} 4' in text
+        # the drained class reads 0, not its last live value
+        assert 'poseidon_audit_frag_slots{sku="4c-8g-8s"} 0' in text
+
+    def test_background_worker_and_metrics(self):
+        m = _metrics()
+        aud = ShadowAuditor(
+            metrics=m, sample_every=1, background=True,
+        )
+        try:
+            br = SchedulerBridge(
+                cost_model="quincy", small_to_oracle=False,
+                metrics=m, auditor=aud,
+            )
+            c = make_synthetic_cluster(12, 20, seed=0)
+            br.observe_nodes(list(c.machines))
+            br.observe_pods(list(c.tasks))
+            _settle(br, rounds=2)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                with aud._lock:
+                    if aud.completed:
+                        break
+                time.sleep(0.05)
+            assert aud.completed >= 1
+            text = m.registry.render()
+            assert "poseidon_audit_regret" in text
+            assert 'poseidon_audit_runs_total{outcome="ok"}' in text
+        finally:
+            aud.stop()
+
+    def test_capture_skips_when_worker_busy(self):
+        aud = ShadowAuditor(sample_every=1, background=False)
+        br = SchedulerBridge(cost_model="quincy", auditor=aud)
+        dc = config6_rebalance(24, 60, seed=0)
+        br.observe_nodes(dc.machines)
+        br.observe_pods(dc.tasks)
+        for _ in range(4):  # queue bound is 2; the rest are skipped
+            br.run_scheduler()
+        assert aud.skipped == 2
+        assert aud.run_pending() is not None
+
+    def test_audit_error_is_counted_not_raised(self):
+        m = _metrics()
+        aud = ShadowAuditor(
+            metrics=m, sample_every=1, background=False,
+        )
+        br = SchedulerBridge(cost_model="quincy", auditor=aud)
+        dc = config6_rebalance(24, 60, seed=0)
+        br.observe_nodes(dc.machines)
+        br.observe_pods(dc.tasks)
+        br.run_scheduler()
+        # doctor the queued snapshot into an unpriceable one
+        snap = aud._q.get_nowait()
+        snap.cost_model = "no-such-model"
+        aud._q.put_nowait(snap)
+        out = aud.run_pending()
+        assert out.error
+        assert aud.failures == 1
+        assert 'poseidon_audit_runs_total{outcome="error"}' in \
+            m.registry.render()
+
+    def test_no_capture_without_running_tasks(self):
+        aud = ShadowAuditor(sample_every=1, background=False)
+        br = SchedulerBridge(cost_model="quincy", auditor=aud)
+        c = make_synthetic_cluster(8, 10, seed=0)
+        br.observe_nodes(list(c.machines))
+        br.observe_pods(list(c.tasks))  # all pending, none running
+        br.run_scheduler()
+        assert aud.run_pending() is None
+
+
+# ---------------------------------------------------------------------------
+# the SLO engine
+# ---------------------------------------------------------------------------
+
+
+class TestSloGrammar:
+    def test_histogram_objective(self):
+        o = parse_objective("e2b_p99_ms < 10 by lane=express")
+        assert o.kind == "histogram"
+        assert o.family == "poseidon_express_e2b_ms"
+        assert o.op == "<" and o.threshold == 10.0
+        assert abs(o.budget - 0.01) < 1e-9
+        assert o.labels == (("lane", "express"),)
+
+    def test_percentile_is_the_budget(self):
+        assert abs(
+            parse_objective("e2c_p50_ms < 100").budget - 0.5
+        ) < 1e-9
+        assert abs(
+            parse_objective("round_p999_ms < 500").budget - 0.001
+        ) < 1e-9
+        # ambiguous spellings rejected: p100 would silently read as
+        # p10 (budget 0.9) and never fire
+        for bad in ("e2b_p100_ms < 10", "e2b_p950_ms < 10",
+                    "e2b_p0_ms < 10"):
+            with pytest.raises(SloParseError, match="percentile"):
+                parse_objective(bad)
+
+    def test_gauge_and_bool_objectives(self):
+        o = parse_objective("regret == 0")
+        assert o.kind == "gauge"
+        assert o.family == "poseidon_audit_regret"
+        r = parse_objective("ready")
+        assert (r.op, r.threshold) == ("==", 1.0)
+
+    def test_threshold_below_smallest_bucket_rejected(self):
+        # E2B buckets start at 0.25: a '< 0.2' objective has no edge
+        # to snap down to and would read 'all good' as 'all bad'
+        with pytest.raises(SloParseError, match="smallest bucket"):
+            SloEngine(["e2b_p99_ms < 0.2"], metrics=_metrics())
+        # unregistered families stay permissive (nothing to check)
+        SloEngine(["e2b_p99_ms < 0.2"], metrics=None)
+
+    def test_parse_errors(self):
+        for bad in (
+            "nope_p99_ms < 10",      # unknown source
+            "regret",                # non-bool gauge without op
+            "e2b_p99_ms",            # histogram without op
+            "e2b_p99_ms > 10",       # percentiles are upper bounds
+            "e2b_p99_ms < 10 by lane",  # bad by clause
+        ):
+            with pytest.raises(SloParseError):
+                parse_objective(bad)
+
+
+class TestSloEngine:
+    def test_gauge_breach_fires_exactly_once_per_window(self):
+        m = _metrics()
+        trace = TraceGenerator()
+        eng = SloEngine(
+            ["regret == 0"], metrics=m, trace=trace,
+            short_window=2, long_window=4,
+        )
+        breaches = lambda: sum(  # noqa: E731
+            1 for e in trace.events if e.event == "SLO_BREACH"
+        )
+        m.audit_regret.set(0)
+        for i in range(4):
+            eng.evaluate(i)
+        assert breaches() == 0
+        m.audit_regret.set(137)  # the breach window opens
+        for i in range(10):      # burns for many rounds...
+            eng.evaluate(10 + i)
+        assert breaches() == 1   # ...but fires exactly once
+        st = eng.status()["objectives"][0]
+        assert st["healthy"] is False
+        assert st["breaches"] == 1
+        # recovery clears the latch...
+        m.audit_regret.set(0)
+        for i in range(6):
+            eng.evaluate(30 + i)
+        assert eng.status()["objectives"][0]["healthy"] is True
+        # ...and the NEXT breach window fires exactly once again
+        m.audit_regret.set(9)
+        for i in range(10):
+            eng.evaluate(50 + i)
+        assert breaches() == 2
+        text = m.registry.render()
+        assert "poseidon_slo_breaches_total" in text
+        assert 'poseidon_slo_burn_rate{slo="regret == 0",window="short"}' \
+            in text
+
+    def test_histogram_objective_burn(self):
+        m = _metrics()
+        eng = SloEngine(
+            ["e2b_p99_ms < 10"], metrics=m,
+            short_window=2, long_window=4,
+        )
+        # healthy traffic: everything under threshold
+        for _ in range(3):
+            m.record_express_batch([1.0, 2.0, 3.0])
+            eng.evaluate(0)
+        st = eng.status()["objectives"][0]
+        assert st["healthy"] is True and st["burn_short"] == 0.0
+        # now 100% of samples over threshold: burn = 1/budget = 100x
+        for _ in range(4):
+            m.record_express_batch([50.0, 80.0])
+            eng.evaluate(1)
+        st = eng.status()["objectives"][0]
+        assert st["burn_short"] > 1.0
+        assert st["healthy"] is False
+
+    def test_ready_objective_tracks_latch(self):
+        m = _metrics()
+        health = HealthState(ready_gauge=m.ready)
+        eng = SloEngine(
+            ["ready"], metrics=m, short_window=2, long_window=2,
+        )
+        eng.evaluate(1)
+        eng.evaluate(2)
+        assert eng.status()["objectives"][0]["healthy"] is False
+        health.mark_seeded()
+        health.mark_round("dense_auction")
+        for i in range(3):
+            eng.evaluate(3 + i)
+        assert eng.status()["objectives"][0]["healthy"] is True
+
+    def test_inf_percentile_never_breaks_render_or_json(self):
+        """A percentile beyond the histogram's top bucket is inf:
+        the metrics render must spell it +Inf (not crash with
+        OverflowError), and /slo JSON must stay strict (null)."""
+        m = _metrics()
+        eng = SloEngine(
+            ["e2b_p99_ms < 10"], metrics=m,
+            short_window=1, long_window=1,
+        )
+        # every sample beyond the 250ms top E2B bucket
+        m.record_express_batch([10_000.0, 20_000.0])
+        eng.evaluate(1)
+        text = m.registry.render()  # must not raise
+        assert 'poseidon_slo_value{slo="e2b_p99_ms < 10"} +Inf' \
+            in text
+        doc = json.loads(json.dumps(eng.status()))  # strict round-trip
+        assert doc["objectives"][0]["value"] is None
+
+    def test_no_samples_is_healthy(self):
+        eng = SloEngine(
+            ["e2b_p99_ms < 10 by lane=express"], metrics=_metrics(),
+        )
+        eng.evaluate(1)
+        st = eng.status()["objectives"][0]
+        assert st["healthy"] is True and st["burn_short"] == 0.0
+
+    def test_slo_endpoint(self):
+        m = _metrics()
+        eng = SloEngine(["regret == 0"], metrics=m)
+        eng.evaluate(1)
+        srv = ObsServer(m.registry, HealthState(), port=0, slo=eng)
+        with srv:
+            url = f"http://127.0.0.1:{srv.port}/slo"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                doc = json.loads(resp.read())
+            assert doc["evaluations"] == 1
+            assert doc["objectives"][0]["spec"] == "regret == 0"
+        srv2 = ObsServer(m.registry, HealthState(), port=0)
+        with srv2:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv2.port}/slo", timeout=5
+                )
+            assert ei.value.code == 404
+            # slo assigned AFTER start() must take effect (handlers
+            # read the attribute per request, not a start-time
+            # snapshot)
+            srv2.slo = eng
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv2.port}/slo", timeout=5
+            ) as resp:
+                assert json.loads(resp.read())["evaluations"] == 1
+
+    def test_breach_lands_in_trace_report(self, tmp_path):
+        path = tmp_path / "t.jsonl"
+        with open(path, "w") as fh:
+            trace = TraceGenerator(sink=fh)
+            m = _metrics()
+            eng = SloEngine(
+                ["regret == 0"], metrics=m, trace=trace,
+                short_window=1, long_window=1,
+            )
+            m.audit_regret.set(5)
+            eng.evaluate(3)
+        from poseidon_tpu.obs.report import (
+            analyze_trace,
+            render_report,
+        )
+
+        data = analyze_trace(str(path))
+        assert data["slo_breaches"] == {"regret == 0": 1}
+        assert "SLO breaches" in render_report(data)
+
+
+# ---------------------------------------------------------------------------
+# trace-ring overwrite visibility
+# ---------------------------------------------------------------------------
+
+
+class TestTraceRingDrop:
+    def test_tiny_ring_counts_overwrites(self):
+        tg = TraceGenerator(buffer_events=4)
+        for i in range(10):
+            tg.emit("SUBMIT", task=f"p{i}")
+        assert len(tg.events) == 4
+        assert tg.dropped_total == 6
+
+    def test_sinked_trace_never_drops(self, tmp_path):
+        with open(tmp_path / "t.jsonl", "w") as fh:
+            tg = TraceGenerator(sink=fh, buffer_events=2)
+            for i in range(10):
+                tg.emit("SUBMIT", task=f"p{i}")
+        assert tg.dropped_total == 0
+
+    def test_bridge_mirrors_drops_into_metric(self):
+        m = _metrics()
+        tiny = TraceGenerator(buffer_events=8)
+        br = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False,
+            trace=tiny, metrics=m,
+        )
+        c = make_synthetic_cluster(8, 30, seed=0)
+        br.observe_nodes(list(c.machines))
+        br.observe_pods(list(c.tasks))  # 30 SUBMITs wrap the ring
+        _settle(br)
+        assert tiny.dropped_total > 0
+        mt = re.search(
+            r"poseidon_trace_dropped_total (\d+)",
+            m.registry.render(),
+        )
+        assert mt and int(mt.group(1)) == tiny.dropped_total
+
+
+# ---------------------------------------------------------------------------
+# label-cardinality bounds (fuzz)
+# ---------------------------------------------------------------------------
+
+
+def _garbage(rng, n=24):
+    alphabet = (
+        "abcdefghijklmnopqrstuvwxyz0123456789-_./:; "
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    )
+    return "".join(
+        rng.choice(alphabet) for _ in range(rng.randint(1, n))
+    )
+
+
+class TestLabelCardinalityBounds:
+    """Out-of-vocabulary label inputs must FOLD to a bounded bucket,
+    never mint a new series — unbounded label churn is how a metrics
+    endpoint ODs its scraper."""
+
+    def test_fold_functions_are_total_and_bounded(self):
+        rng = random.Random(0)
+        seen = set()
+        for _ in range(500):
+            g = _garbage(rng)
+            seen.add(lane_label(g))
+            seen.add(degrade_why_label(g))
+            seen.add(build_mode_label(g))
+            seen.add(resource_label(g))
+            seen.add(resync_reason_label(g))
+            seen.add(bounded_lane(g))
+        from poseidon_tpu.obs.metrics import _LANE_PARTS
+
+        vocab = (
+            _LANE_PARTS | _DEGRADE_WHYS | _BUILD_MODES
+            | set(LANES)
+            | {"other", "round", "nodes", "pods",
+               "gone", "stale", "decode", "error", "none",
+               "delta", "full", "legacy"}
+        )
+        assert seen <= vocab
+
+    def test_recording_garbage_never_mints_labels(self):
+        rng = random.Random(1)
+        m = _metrics()
+        for _ in range(100):
+            m.record_degrade(_garbage(rng))
+            m.record_express_degrade(_garbage(rng))
+            m.record_resync(_garbage(rng))
+            m.record_reconnect(_garbage(rng))
+            m.record_pod_e2c(1.0, _garbage(rng))
+            stats = SchedulerStats(
+                round_num=1, lane=_garbage(rng),
+                build_mode=_garbage(rng),
+                backend="oracle:" + _garbage(rng),
+                total_ms=1.0,
+            )
+            m.record_round(stats)
+        text = m.registry.render()
+        values = set(re.findall(r'(\w+)="([^"]*)"', text))
+        for key, val in values:
+            if key in ("lane", "why", "reason", "resource",
+                       "build_mode"):
+                assert val in (
+                    _DEGRADE_WHYS | _BUILD_MODES | set(LANES)
+                    | {"other", "round", "express",
+                       "gone", "stale", "decode", "error",
+                       "unconfirmed", "domain", "uncertified",
+                       "change-cap", "batch-size", "rows-exhausted",
+                       "no-context", "round-in-flight",
+                       "aggregation", "prefs", "vocabulary",
+                       "nodes", "pods"}
+                ), (key, val)
+        # and the degrade counter's series count stays bounded no
+        # matter how much garbage went in
+        assert text.count("poseidon_degrades_total{") <= \
+            len(_DEGRADE_WHYS) + 1
+
+
+# ---------------------------------------------------------------------------
+# device telemetry satellites
+# ---------------------------------------------------------------------------
+
+
+class TestDeviceTelemetry:
+    def test_predicted_bytes_gauge_set_by_dense_round(self):
+        m = _metrics()
+        br = SchedulerBridge(
+            cost_model="quincy", small_to_oracle=False, metrics=m,
+        )
+        c = make_synthetic_cluster(12, 20, seed=0)
+        br.observe_nodes(list(c.machines))
+        br.observe_pods(list(c.tasks))
+        res = br.run_scheduler()
+        assert res.stats.backend == "dense_auction"
+        mt = re.search(
+            r'poseidon_device_hbm_bytes\{kind="predicted"\} (\d+)',
+            m.registry.render(),
+        )
+        assert mt and int(mt.group(1)) > 0
+
+    def test_live_hbm_is_gated_on_platform_support(self):
+        m = _metrics()
+        out = m.record_live_hbm()
+        text = m.registry.render()
+        if out is None:
+            # CPU backends expose no memory_stats: nothing published
+            assert 'kind="live"' not in text
+        else:
+            assert 'kind="live"' in text
+
+    def test_compile_latency_histogram_via_monitoring_seam(self):
+        import jax.numpy as jnp
+
+        from poseidon_tpu.guards import set_compile_duration_sink
+
+        m = _metrics()
+        if not set_compile_duration_sink(m.record_compile):
+            pytest.skip("jax.monitoring not available")
+        try:
+            # a fresh jitted shape forces one backend compile
+            _COMPILE_PROBE(jnp.arange(173)).block_until_ready()
+            mt = re.search(
+                r"poseidon_xla_compile_ms_count (\d+)",
+                m.registry.render(),
+            )
+            assert mt and int(mt.group(1)) >= 1
+        finally:
+            set_compile_duration_sink(None)

@@ -92,6 +92,16 @@ _OBS_HOT_SCOPES = {
         "SchedulerMetrics.record_checkpoint_age",
         "SchedulerMetrics.record_journal_replay",
         "SchedulerMetrics.record_restore",
+        # failure-domain recorders: guard hold/release fire inside
+        # the observe path, outage/outbox/shed/watchdog inside the
+        # driver tick — all host ints already in hand
+        "SchedulerMetrics.record_guard_hold",
+        "SchedulerMetrics.record_guard_release",
+        "SchedulerMetrics.record_outage",
+        "SchedulerMetrics.record_outbox",
+        "SchedulerMetrics.record_express_shed",
+        "SchedulerMetrics.record_deadline_miss",
+        "SchedulerMetrics.record_overload_cleared",
     ),
     "poseidon_tpu/obs/spans.py": (
         "round_span_tree",
@@ -331,6 +341,23 @@ DEFAULT_CONTRACTS = Contracts(
             "_plan_from_keys",
             "_pinned_mask",
         ),
+        # the actuation outbox (ha/outbox.py) pumps once per tick in
+        # the driver loop's observe window: O(outbox-entries) only —
+        # an O(cluster) walk here would bill every healthy tick for
+        # the outage machinery
+        "poseidon_tpu/ha/outbox.py": (
+            "ActuationOutbox.enqueue",
+            "ActuationOutbox.pump",
+            "ActuationOutbox._pump_pass",
+            "OutageDetector.note_failure",
+            "OutageDetector.note_success",
+        ),
+        # the chaos orchestrator's injection step runs on the driver
+        # thread between rounds (cli round_hook): schedule lookups
+        # and bounded injections only, never a cluster walk
+        "poseidon_tpu/chaos/scenarios.py": (
+            "ChaosOrchestrator.on_round",
+        ),
         # metric recording + span assembly (_OBS_HOT_SCOPES): an
         # O(cluster) walk there would bill every round for its own
         # observability
@@ -400,6 +427,14 @@ DEFAULT_CONTRACTS = Contracts(
         "ActuationJournal": ThreadContract(
             lock_attr="_lock", handoffs={}
         ),
+        # the actuation outbox (ha/outbox.py): pump/drop on the
+        # driver thread, enqueue ALSO from the bounded binding-POST
+        # pool workers (cli _post_bindings) — the entry list is
+        # guarded by _lock on every access; the lifetime counters
+        # are pump-side (driver-thread) only
+        "ActuationOutbox": ThreadContract(
+            lock_attr="_lock", handoffs={}
+        ),
         # the shadow auditor (obs/audit.py): capture on the driver
         # thread, the re-solve on the audit worker; the snapshot
         # handoff is a bounded queue.Queue of immutable-after-capture
@@ -429,6 +464,12 @@ DEFAULT_CONTRACTS = Contracts(
                 "last_activity": "monotonic float heartbeat; a stale "
                                  "read only delays the staleness resync "
                                  "by one tick",
+                "coalesced_reconnects":
+                    "monotonic int advanced only by the reader thread "
+                    "(queue-suppressed reconnects during an outage); "
+                    "the consumer folds deltas via a private cursor — "
+                    "same GIL-int pattern as seen_rv, staleness costs "
+                    "one tick of count lag, never a lost count",
             },
         ),
     },

@@ -38,6 +38,7 @@ import http.client
 import json
 import logging
 import random
+import threading
 import time
 import urllib.error
 import urllib.parse
@@ -87,6 +88,40 @@ def backoff_delay(
     (apiclient/watch.py).
     """
     return min(cap_s, base_s * (2.0 ** attempt)) * (0.5 + rng())
+
+
+# retry-stat classes: every retried failure is attributed to exactly
+# one of these, so "the apiserver is hanging" (timeout) reads
+# differently from "the apiserver is erroring" (5xx) in the stats —
+# a hung apiserver is the common real-world outage shape and the two
+# need different operator responses (socket timeouts vs error budgets)
+RETRY_CLASSES = ("5xx", "429", "timeout", "transport", "decode")
+
+
+def _failure_class(e: Exception) -> str:
+    """Attribute one retried failure to a RETRY_CLASSES bucket."""
+    if isinstance(e, urllib.error.HTTPError):
+        return "429" if e.code == 429 else "5xx"
+    if isinstance(e, json.JSONDecodeError):
+        return "decode"
+    # socket timeouts surface either bare (http.client reads) or
+    # wrapped in URLError(reason=timeout) (urlopen connects)
+    if isinstance(e, TimeoutError):
+        return "timeout"
+    if isinstance(e, urllib.error.URLError) and isinstance(
+        getattr(e, "reason", None), TimeoutError
+    ):
+        return "timeout"
+    return "transport"
+
+
+def _wire_failure(code: int) -> bool:
+    """True when an ApiError's code means the WIRE (not the request)
+    is the problem: transport-level (0), throttled past the retry
+    budget (429), or server-side trouble (5xx). The single source of
+    the outage ladder's unreachable-vs-rejected split — bind and
+    evict must never disagree on it."""
+    return code == 0 or code == 429 or code >= 500
 
 
 def parse_cpu(q: str | int | float) -> float:
@@ -143,9 +178,21 @@ class K8sApiClient:
         self.page_limit = page_limit
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
+        # per-class retried-failure counts (RETRY_CLASSES); requests
+        # run concurrently from the binding-POST pool, so increments
+        # hold a lock. A hung apiserver ("timeout") is counted
+        # distinctly from an erroring one ("5xx").
+        self.retry_stats: dict[str, int] = dict.fromkeys(
+            RETRY_CLASSES, 0
+        )
+        self._stats_lock = threading.Lock()
         log.info("k8s api client -> %s", self.base)
 
     # ---- transport -----------------------------------------------------
+
+    def _count_failure(self, e: Exception) -> None:
+        with self._stats_lock:
+            self.retry_stats[_failure_class(e)] += 1
 
     def _request(
         self, path: str, body: dict | None = None,
@@ -181,6 +228,7 @@ class K8sApiClient:
                 if e.code == 429:
                     retry_after = e.headers.get("Retry-After", "")
                 last = e
+                self._count_failure(e)
             except (
                 OSError,
                 http.client.HTTPException,
@@ -190,8 +238,11 @@ class K8sApiClient:
                 # socket errors (ConnectionResetError) that surface
                 # under concurrent bindings POSTs mid-body-read;
                 # HTTPException covers IncompleteRead when the server
-                # drops the connection mid-body
+                # drops the connection mid-body. A socket timeout (the
+                # hung-apiserver case) is attributed to its own retry-
+                # stat class, distinct from 5xx/transport.
                 last = e
+                self._count_failure(e)
             if attempt < self.retries:
                 delay = backoff_delay(
                     attempt,
@@ -415,6 +466,28 @@ class K8sApiClient:
         namespace) or a qualified ``"ns/name"`` uid as produced by
         ``_parse_pod`` — the qualifier then wins over ``namespace``.
         """
+        return self.bind_outcome(pod, node, namespace) == "ok"
+
+    def bind_outcome(
+        self, pod: str, node: str, namespace: str = "default"
+    ) -> str:
+        """Outcome-classified binding POST — the actuation-outbox
+        seam (ha/outbox.py). Returns one of:
+
+        - ``"ok"``: the binding landed (or a 409 Conflict whose
+          existing binding targets the SAME node — a duplicate of an
+          op that already landed: a retried request, a journal replay
+          after a crash, a restarted daemon re-actuating. Counting it
+          as failed would inflate bind_failures and age/re-queue a
+          pod the apiserver already placed exactly where we asked);
+        - ``"rejected"``: the apiserver answered and said no (404
+          pod/node gone, 409 bound elsewhere, 4xx) — retrying the
+          same POST cannot heal it, the pod must be re-queued;
+        - ``"unreachable"``: the apiserver could not be reached or
+          kept erroring (transport, timeout, 5xx/429 exhausted) —
+          the *wire* is the problem, not the decision, so the op
+          belongs in the outbox, not back in the solver.
+        """
         if "/" in pod:
             namespace, pod = pod.split("/", 1)
         body = {
@@ -427,16 +500,9 @@ class K8sApiClient:
         }
         try:
             self._request(f"namespaces/{namespace}/bindings", body)
-            return True
+            return "ok"
         except ApiError as e:
             if e.code == 409:
-                # Conflict: a binding already exists. When it targets
-                # the SAME node this POST is a duplicate of an op that
-                # already landed (a retried request, a journal replay
-                # after a crash, a restarted daemon re-actuating) —
-                # that is SUCCESS, not a failure: counting it as failed
-                # would inflate bind_failures and age/re-queue a pod
-                # the apiserver already placed exactly where we asked.
                 try:
                     cur = self.get_pod(pod, namespace=namespace)
                 except ApiError:
@@ -446,9 +512,10 @@ class K8sApiClient:
                         "binding %s -> %s already exists; counting "
                         "the duplicate POST as success", pod, node,
                     )
-                    return True
+                    return "ok"
             log.error("binding %s -> %s failed: %s", pod, node, e)
-            return False
+            return "unreachable" if _wire_failure(e.code) \
+                else "rejected"
 
     # ---- evictions -----------------------------------------------------
 
@@ -461,6 +528,13 @@ class K8sApiClient:
         re-offered with its aging preserved). ``pod`` accepts the same
         bare-or-qualified forms as ``bind_pod_to_node``.
         """
+        return self.evict_outcome(pod, namespace) == "ok"
+
+    def evict_outcome(
+        self, pod: str, namespace: str = "default"
+    ) -> str:
+        """Outcome-classified eviction POST; the same
+        ok / rejected / unreachable vocabulary as ``bind_outcome``."""
         if "/" in pod:
             namespace, pod = pod.split("/", 1)
         body = {
@@ -472,10 +546,11 @@ class K8sApiClient:
             self._request(
                 f"namespaces/{namespace}/pods/{pod}/eviction", body
             )
-            return True
+            return "ok"
         except ApiError as e:
             log.error("eviction of %s failed: %s", pod, e)
-            return False
+            return "unreachable" if _wire_failure(e.code) \
+                else "rejected"
 
     # ---- leases (HA leader election, ha/standby.py) --------------------
 

@@ -29,9 +29,20 @@ comments (k8s_api_client.cc:96-99) — but never builds the fixture
 Fault injection for resilience tests: ``fail_next(n)`` makes the next n
 requests return HTTP 500; ``rate_limit_next(n)`` answers 429 with a
 ``Retry-After`` header; ``disconnect_next(n)`` closes the connection
-mid-body (a promised Content-Length never delivered); ``drop_node(name)``
+mid-body (a promised Content-Length never delivered); ``delay_next(n,
+seconds)`` serves the next n requests only after sleeping — the HUNG
+apiserver (the common real outage shape): a client whose socket
+timeout is shorter sees a read timeout, not an error status;
+``set_outage(True)`` answers EVERY request 503 until cleared (a whole
+apiserver outage window, time-based rather than request-counted;
+``writes_only=True`` fails only mutations — the reads-OK/writes-down
+shape of an etcd write-quorum loss);
+``drop_node(name)``
 removes a node between polls (the node-removal path the reference never
-handled); ``truncate_lists(n)`` serves only the first n items WITHOUT a
+handled) and — like the real node-lifecycle controller — orphans its
+bound pods back to Pending (``orphan_pods=False`` restores the old
+leave-them-bound behavior); ``truncate_lists(n)`` serves only the
+first n items WITHOUT a
 continue token (a partial snapshot masquerading as complete — the
 failure mode the bridge's mass-eviction guard exists for). Watch-side:
 ``gone_next_watch(n)`` answers the next n watch connects with HTTP 410;
@@ -66,12 +77,27 @@ class FakeApiServer:
         self.pods: dict[str, dict] = {}
         self.bindings: list[tuple[str, str]] = []
         self.evictions: list[str] = []
+        # the ONE ordered actuation history ("bind"|"evict", pod, node)
+        # in accepted-POST order — the chaos invariant checker's
+        # exactly-once evidence (bindings/evictions above are separate
+        # lists and lose the interleaving)
+        self.op_log: list[tuple[str, str, str]] = []
         # bind/evict ops applied in POST order on the next pods poll
         self._pending_ops: list[tuple[str, str, str]] = []
         self._fail_next = 0
         self._rate_limit_next = 0
         self._rate_limit_retry_after = 0.05
         self._disconnect_next = 0
+        # slow-response injection: the next n requests sleep this long
+        # before answering (a HUNG apiserver — clients with shorter
+        # socket timeouts see a read timeout, not an error)
+        self._delay_next = 0
+        self._delay_s = 0.0
+        # outage window: while set, EVERY request answers 503 —
+        # or only mutations (POST/PUT/DELETE) with writes_only, the
+        # reads-OK/writes-down shape an etcd write-quorum loss has
+        self._outage = False
+        self._outage_writes_only = False
         # crash-consistency injection (ha/ tests): the next n mutation
         # POSTs are APPLIED and then the connection dies without a
         # response — the "op landed but the caller never learned"
@@ -134,10 +160,14 @@ class FakeApiServer:
                 self.wfile.flush()
                 self.close_connection = True
 
-            def _injected_fault(self) -> str:
+            def _injected_fault(self, write: bool = False) -> str:
                 """Consume one injected request-level fault, if armed."""
                 with server._lock:
                     server.requests_served += 1
+                    if server._outage and (
+                        write or not server._outage_writes_only
+                    ):
+                        return "outage"
                     if server._fail_next > 0:
                         server._fail_next -= 1
                         return "fail"
@@ -147,11 +177,16 @@ class FakeApiServer:
                     if server._disconnect_next > 0:
                         server._disconnect_next -= 1
                         return "disconnect"
+                    if server._delay_next > 0:
+                        server._delay_next -= 1
+                        return "delay"
                 return ""
 
             def _apply_fault(self, fault: str) -> bool:
                 if fault == "fail":
                     self._reply(500, {"error": "injected"})
+                elif fault == "outage":
+                    self._reply(503, {"error": "outage window"})
                 elif fault == "rate":
                     self._reply(
                         429, {"error": "throttled"},
@@ -162,6 +197,13 @@ class FakeApiServer:
                     )
                 elif fault == "disconnect":
                     self._drop_mid_body()
+                elif fault == "delay":
+                    # slow, not broken: sleep OUTSIDE the lock, then
+                    # serve normally — a client whose socket timeout is
+                    # shorter has hung up by then (its write error is
+                    # swallowed by the quiet server's handle_error)
+                    time.sleep(server._delay_s)
+                    return False
                 else:
                     return False
                 return True
@@ -333,7 +375,7 @@ class FakeApiServer:
                 self._chunk_raw(b"")  # terminating chunk
 
             def do_POST(self):
-                fault = self._injected_fault()
+                fault = self._injected_fault(write=True)
                 if self._apply_fault(fault):
                     return
                 with server._lock:
@@ -379,6 +421,7 @@ class FakeApiServer:
                             return
                         server._pending_ops.append(("bind", key, node))
                         server.bindings.append((key, node))
+                        server.op_log.append(("bind", key, node))
                         # wake parked watch streams so the binding
                         # becomes observable at their next wake, like
                         # the next poll would make it
@@ -402,6 +445,7 @@ class FakeApiServer:
                             return
                         server._pending_ops.append(("evict", key, ""))
                         server.evictions.append(key)
+                        server.op_log.append(("evict", key, ""))
                         server._cond.notify_all()
                         if server._take_apply_then_disconnect():
                             self.close_connection = True
@@ -413,7 +457,7 @@ class FakeApiServer:
             # ---- leases (leader election, ha/standby.py) -----------
 
             def do_PUT(self):
-                if self._apply_fault(self._injected_fault()):
+                if self._apply_fault(self._injected_fault(write=True)):
                     return
                 url = urlparse(self.path)
                 parts = url.path.strip("/").split("/")
@@ -465,7 +509,7 @@ class FakeApiServer:
                         )
 
             def do_DELETE(self):
-                if self._apply_fault(self._injected_fault()):
+                if self._apply_fault(self._injected_fault(write=True)):
                     return
                 url = urlparse(self.path)
                 query = parse_qs(url.query)
@@ -494,7 +538,20 @@ class FakeApiServer:
                         del server.leases[key]
                         self._reply(200, {"status": "Released"})
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        class _QuietServer(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # a delayed reply hitting a hung-up client (the
+                # delay_next injection outliving the client's socket
+                # timeout) is the EXPECTED outcome, not a server bug —
+                # keep the default traceback spam out of test output
+                import sys
+                exc = sys.exc_info()[1]
+                if isinstance(exc, (BrokenPipeError,
+                                    ConnectionResetError)):
+                    return
+                super().handle_error(request, client_address)
+
+        self._httpd = _QuietServer(("127.0.0.1", 0), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
@@ -699,11 +756,33 @@ class FakeApiServer:
             if doc is not None:
                 self._emit("pods", "DELETED", doc)
 
-    def drop_node(self, name: str) -> None:
+    def drop_node(self, name: str, orphan_pods: bool = True) -> None:
+        """Remove a node. Like the real node-lifecycle controller,
+        pods bound to it are orphaned back to Pending (nodeName
+        cleared, MODIFIED events) so the scheduler's re-placement
+        bindings do not 409 against a binding to a dead node;
+        ``orphan_pods=False`` leaves them bound (the stale-cache
+        shape some control planes expose briefly)."""
         with self._lock:
             doc = self.nodes.pop(name, None)
             if doc is not None:
+                # fold queued bind/evict ops FIRST: a bind POSTed but
+                # not yet applied to this node would otherwise escape
+                # the orphan scan (nodeName still unset) and later
+                # land the pod Running on a dead node
+                self._apply_pending()
                 self._emit("nodes", "DELETED", doc)
+                if orphan_pods:
+                    for key, pod in self.pods.items():
+                        if pod.get("spec", {}).get("nodeName") == name:
+                            pod["spec"].pop("nodeName", None)
+                            pod.setdefault("status", {})[
+                                "phase"] = "Pending"
+                            # the op_log's "this pod may legitimately
+                            # be re-bound" marker (chaos exactly-once
+                            # checker): node death, not an eviction
+                            self.op_log.append(("orphan", key, name))
+                            self._emit("pods", "MODIFIED", pod)
 
     def fail_next(self, n: int) -> None:
         with self._lock:
@@ -720,6 +799,29 @@ class FakeApiServer:
         half delivered)."""
         with self._lock:
             self._disconnect_next = n
+
+    def delay_next(self, n: int, seconds: float) -> None:
+        """Serve the next n requests only after sleeping ``seconds``
+        — the hung apiserver. A client whose socket timeout is
+        shorter sees a READ TIMEOUT (counted distinctly from 5xx in
+        ``K8sApiClient.retry_stats``), not an error status; the
+        request is otherwise served normally after the sleep."""
+        with self._lock:
+            self._delay_next = n
+            self._delay_s = seconds
+
+    def set_outage(self, on: bool, writes_only: bool = False) -> None:
+        """An apiserver outage window: while on, EVERY request
+        (lists, watches, mutations, leases) answers 503. Time-based
+        where ``fail_next`` is request-counted — the shape a real
+        control-plane outage has. ``writes_only=True`` fails only the
+        mutations (POST/PUT/DELETE) while reads keep answering — the
+        reads-OK/writes-down shape an etcd write-quorum loss produces
+        (a successful poll must NOT clear a declared outage while
+        actuations still cannot land)."""
+        with self._lock:
+            self._outage = on
+            self._outage_writes_only = writes_only
 
     def apply_then_disconnect_next(self, n: int) -> None:
         """The crash-consistency fault: the next n mutation POSTs are

@@ -125,6 +125,12 @@ class ExpressEvents:
     t_events: list[float] = dataclasses.field(default_factory=list)
     reconnects: int = 0
     needs_tick: bool = False
+    # overload backpressure: the pods stream's queue depth exceeded
+    # the shed threshold — the express lane stepped aside so the full
+    # round (which handles arbitrarily large batches in one solve)
+    # absorbs the burst instead of the per-batch fast path grinding
+    # through it event by event. Counted loudly by the driver.
+    shed: bool = False
 
 
 class _WatchStream(threading.Thread):
@@ -157,6 +163,16 @@ class _WatchStream(threading.Thread):
         self.queue: queue.Queue = queue.Queue()
         self.gone = threading.Event()
         self.last_activity = time.monotonic()
+        # reconnect coalescing: during a long apiserver outage the
+        # retry loop fails every backoff period — enqueueing one
+        # RECONNECT item per attempt would grow the pending-event
+        # queue without bound for as long as the outage lasts. Only
+        # the FIRST failure of a consecutive run is enqueued (it
+        # carries the reason); the rest advance this monotonic
+        # counter, which the consumer folds into its reconnect counts
+        # (the seen_rv read pattern). Queue memory during an outage
+        # is O(1), the counts stay exact.
+        self.coalesced_reconnects = 0
         self._halt = threading.Event()
         self._resp = None
 
@@ -185,9 +201,15 @@ class _WatchStream(threading.Thread):
                     urllib.error.URLError) as e:
                 if self._halt.is_set():
                     return
-                self.queue.put(
-                    ("RECONNECT", f"connect failed: {e}")
-                )
+                if attempt == 0:
+                    self.queue.put(
+                        ("RECONNECT", f"connect failed: {e}")
+                    )
+                else:
+                    # consecutive failures coalesce (bounded queue
+                    # memory over a long outage); the count stays
+                    # exact via the monotonic counter
+                    self.coalesced_reconnects += 1
                 time.sleep(backoff_delay(
                     attempt, base_s=self.backoff_base_s,
                     cap_s=self.backoff_cap_s,
@@ -342,6 +364,16 @@ class ClusterWatcher:
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
         self._streams: dict[str, _WatchStream] = {}
+        # per-resource cursor into each stream's coalesced-reconnect
+        # counter (folded into tick()'s counts; reset when sync/resume
+        # replace the stream objects — any unfolded residual is
+        # carried so the counts stay exact across a resync)
+        self._coalesced_seen: dict[str, int] = dict.fromkeys(
+            RESOURCES, 0
+        )
+        self._carry_coalesced: dict[str, int] = dict.fromkeys(
+            RESOURCES, 0
+        )
         self._applied_rv: dict[str, int] = dict.fromkeys(RESOURCES, 0)
         self._seeded = False
         # a degradation whose resync LIST has not succeeded yet; kept
@@ -370,16 +402,46 @@ class ClusterWatcher:
 
     # ---- sync (seed / resync) ----
 
+    def _carry_residual_coalesced(self) -> None:
+        """Before discarding the stream objects, bank each stream's
+        not-yet-folded coalesced reconnects — a resync mid-outage
+        must not lose exactly the counts the outage minted."""
+        for resource, s in self._streams.items():
+            residual = (
+                s.coalesced_reconnects
+                - self._coalesced_seen.get(resource, 0)
+            )
+            if residual > 0:
+                self._carry_coalesced[resource] = (
+                    self._carry_coalesced.get(resource, 0) + residual
+                )
+
+    def _take_carry(self) -> int:
+        """Fold the banked residuals into the metrics; returns the
+        total (the caller adds it to this tick's reconnect count,
+        whose flow already feeds ``reconnects_total``)."""
+        total = 0
+        for resource, n in self._carry_coalesced.items():
+            if n > 0:
+                total += n
+                if self.metrics is not None:
+                    self.metrics.record_reconnect(resource, amount=n)
+        if total:
+            self._carry_coalesced = dict.fromkeys(RESOURCES, 0)
+        return total
+
     def sync(self) -> tuple[list[Machine], list[Task]]:
         """Full paginated LIST of both resources; restarts both streams
         from the snapshot rvs. Raises ``ApiError`` if the LISTs fail
         (the caller skips the tick, like a failed poll) — the watcher
         stays un-seeded so the NEXT tick retries the sync rather than
         ticking over zero streams forever."""
+        self._carry_residual_coalesced()
         self.stop()
         self._seeded = False
         nodes, nodes_rv = self.client.nodes_with_rv()
         pods, pods_rv = self.client.pods_with_rv()
+        self._coalesced_seen = dict.fromkeys(RESOURCES, 0)
         self._applied_rv = {"nodes": nodes_rv, "pods": pods_rv}
         for resource, rv in (("nodes", nodes_rv), ("pods", pods_rv)):
             s = _WatchStream(
@@ -403,7 +465,9 @@ class ClusterWatcher:
         ``tick()`` degrades to the LOUD full-LIST resync (snapshot-diff
         path, mass-eviction guard armed) — stale resumption never
         guesses."""
+        self._carry_residual_coalesced()
         self.stop()
+        self._coalesced_seen = dict.fromkeys(RESOURCES, 0)
         self._applied_rv = {
             r: int(rvs.get(r, 0)) for r in RESOURCES
         }
@@ -446,6 +510,8 @@ class ClusterWatcher:
             # first seed, or the retry of a resync whose LIST failed
             reason = self._resync_reason
             nodes, pods = self.sync()
+            carried = self._take_carry()
+            self.reconnects_total += carried
             if reason:
                 self._resync_reason = ""
                 self.resyncs_total += 1
@@ -455,10 +521,16 @@ class ClusterWatcher:
                 if self.metrics is not None:
                     self.metrics.record_resync(reason)
                 return ObserveDelta(
-                    resynced=True, nodes=nodes, pods=pods, resyncs=1
+                    resynced=True, nodes=nodes, pods=pods, resyncs=1,
+                    reconnects=carried,
                 )
-            return ObserveDelta(resynced=True, nodes=nodes, pods=pods)
-        reconnects = 0
+            return ObserveDelta(
+                resynced=True, nodes=nodes, pods=pods,
+                reconnects=carried,
+            )
+        # residuals banked by a previous sync/resume (streams replaced
+        # mid-outage) fold into this tick's count
+        reconnects = self._take_carry()
         node_events: list[tuple[str, Machine]] = []
         pod_events: list[tuple[str, Task]] = []
         resync_reason = ""
@@ -507,6 +579,18 @@ class ClusterWatcher:
                         node_events.append((typ, parsed))
                     else:
                         pod_events.append((typ, parsed))
+            # fold the stream's coalesced (queue-suppressed)
+            # reconnects into this tick's counts — exact totals with
+            # O(1) queue memory over a long outage
+            cr = stream.coalesced_reconnects
+            coalesced = cr - self._coalesced_seen[resource]
+            if coalesced > 0:
+                self._coalesced_seen[resource] = cr
+                reconnects += coalesced
+                if self.metrics is not None:
+                    self.metrics.record_reconnect(
+                        resource, amount=coalesced
+                    )
             if not resync_reason and stream.gone.is_set():
                 resync_reason = f"{resource}: stream gone"
             if not resync_reason and (
@@ -591,7 +675,8 @@ class ClusterWatcher:
                     self.metrics.record_reconnect("nodes")
 
     def express_poll(
-        self, timeout_s: float, max_events: int = 16
+        self, timeout_s: float, max_events: int = 16,
+        shed_queue: int = 0,
     ) -> ExpressEvents:
         """Block up to ``timeout_s`` for pod watch events between round
         ticks; returns as soon as a small batch is available.
@@ -611,6 +696,16 @@ class ClusterWatcher:
         nodes = self._streams.get("nodes")
         if not self._seeded or pods is None or pods.gone.is_set():
             out.needs_tick = True
+            return out
+        if shed_queue > 0 and pods.queue.qsize() > shed_queue:
+            # overload shed: more events are queued than the express
+            # lane should grind through batch by batch — hand the
+            # whole burst to the tick path's single full solve.
+            # qsize() is advisory but one-sided-safe here: an
+            # undercount delays the shed by one poll, never loses
+            # events (they stay queued for tick()).
+            out.needs_tick = True
+            out.shed = True
             return out
         deadline = time.monotonic() + timeout_s
         while len(out.pod_events) < max_events:

@@ -132,6 +132,12 @@ log = logging.getLogger(__name__)
 # SHRINK_MIN_KNOWN known entities) unless it repeats this many polls.
 SHRINK_STRIKES = 3
 SHRINK_MIN_KNOWN = 8
+# The guard's time-based exit (the NotReady grace window): a held
+# shrink that persists this many seconds is accepted as true death
+# even before SHRINK_STRIKES polls land — watch-mode daemons resync
+# rarely, so a purely poll-counted guard could hold a real rack loss
+# for as long as the streams stay healthy. 0 disables the time exit.
+SHRINK_GRACE_S = 45.0
 
 
 @dataclasses.dataclass
@@ -167,8 +173,20 @@ class SchedulerStats:
     deltas_deferred: int = 0
     # placement/migration POSTs the driver reported failed since the
     # previous round (the pods were re-queued, not silently believed
-    # placed)
+    # placed). During a declared apiserver outage, unreachable POSTs
+    # park in the actuation outbox instead (ha/outbox.py) and do NOT
+    # count here until they dead-letter — so an outage reads as one
+    # episode, not a failure per pod per round.
     bind_failures: int = 0
+    # staged node-death re-queue (the mass-eviction guard's exit):
+    # displaced RUNNING pods admitted into this round's schedulable
+    # set, and the backlog still parked awaiting a later wave
+    requeue_admitted: int = 0
+    displaced_parked: int = 0
+    # actuations parked in the driver's outbox when this round was
+    # logged (cli stamps it; 0 without an outbox) — the chaos
+    # harness's time-to-recovered clock includes the drain
+    outbox_pending: int = 0
     # watch-mode degradation counters since the previous round: full
     # LIST resyncs (410 Gone / decode error / staleness) and error-path
     # stream reconnects (apiclient/watch.py; zero in poll mode)
@@ -286,6 +304,7 @@ class SchedulerBridge:
         topk_prefs: int = 0,
         express_lane: bool = False,
         express_max_batch: int = 16,
+        shrink_grace_s: float = SHRINK_GRACE_S,
         metrics=None,
         profile_spans: bool = False,
         solver=None,
@@ -383,9 +402,26 @@ class SchedulerBridge:
         self._observe_ms = 0.0
         self._watch_resyncs = 0
         self._watch_reconnects = 0
-        # consecutive implausible-shrink polls (mass-eviction guard)
+        # consecutive implausible-shrink polls (mass-eviction guard),
+        # plus the monotonic stamp of each hold's first strike (the
+        # NotReady grace window's clock; 0.0 = not holding)
         self._node_shrink_strikes = 0
         self._pod_shrink_strikes = 0
+        self._node_shrink_strikes_first = 0.0
+        self._pod_shrink_strikes_first = 0.0
+        self.shrink_grace_s = shrink_grace_s
+        # staged re-queue of node-death displacement: RUNNING pods on
+        # a dead node flip to Pending but only ``max_migrations_per_
+        # round`` of them become SCHEDULABLE per round — the rest park
+        # here (ordered, FIFO admission at begin_round) so a rack loss
+        # drains as bounded waves, not one migration-storm round.
+        # Parked pods stay in self.tasks (state truth) but are
+        # excluded from cluster_state()/the graph until admitted.
+        self._displaced_parked: dict[str, None] = {}
+        self._requeue_budget_left: int | None = (
+            max_migrations_per_round
+            if max_migrations_per_round > 0 else None
+        )  # None = unlimited; refreshed every begin_round
         # resync-storm trip for the flight recorder: a sliding window
         # of per-round resync counts (the obs/metrics.py storm gauge's
         # twin), latched so a persisting storm dumps once, not every
@@ -413,6 +449,21 @@ class SchedulerBridge:
         self._express_degrades = 0
         self._express_e2b: list[float] = []
 
+    def _guard_release(self, kind: str, outcome: str, *,
+                       gone: int = 0, known: int = 0,
+                       strikes: int = 0, held_s: float = 0.0) -> None:
+        """One guard release: trace event + metrics (outcome is
+        "accepted" — the shrink was honored as true death — or
+        "recovered" — the snapshot healed before the bound)."""
+        self.trace.emit(
+            "EVICTION_GUARD_RELEASE", round_num=self.round_num,
+            detail={"kind": kind, "outcome": outcome, "gone": gone,
+                    "known": known, "strikes": strikes,
+                    "held_s": round(held_s, 3)},
+        )
+        if self.metrics is not None:
+            self.metrics.record_guard_release(kind, outcome)
+
     def _hold_shrink(self, counter: str, kind: str, known: int,
                      gone: int) -> bool:
         """Mass-eviction guard: True = hold this poll's disappearances.
@@ -420,24 +471,64 @@ class SchedulerBridge:
         ``known`` is the entity count BEFORE the poll's upserts — a
         truncated snapshot that also carries new names must not inflate
         the denominator and slip past the threshold.
+
+        Two exits (both loud — EVICTION_GUARD_RELEASE + metrics):
+        the shrink persists ``SHRINK_STRIKES`` consecutive polls, or
+        it persists past the ``shrink_grace_s`` NotReady grace window
+        (``--node_grace_s``) — after either, the disappearances are
+        accepted as TRUE death and the displaced RUNNING pods drain
+        through the staged-requeue budget instead of one storm round.
+        A snapshot that heals mid-hold releases with
+        ``outcome="recovered"`` and nothing is evicted.
         """
+        first_attr = counter + "_first"
         if known < SHRINK_MIN_KNOWN or gone * 2 <= known:
+            if getattr(self, counter):
+                # the hold healed: the disappearance was transient
+                self._guard_release(
+                    kind, "recovered", gone=gone, known=known,
+                    strikes=getattr(self, counter),
+                    held_s=time.monotonic() - getattr(self, first_attr),
+                )
             setattr(self, counter, 0)
+            setattr(self, first_attr, 0.0)
             return False
         strikes = getattr(self, counter) + 1
         setattr(self, counter, strikes)
-        if strikes < SHRINK_STRIKES:
+        now = time.monotonic()
+        if strikes == 1:
+            setattr(self, first_attr, now)
+        held_s = now - getattr(self, first_attr)
+        grace_hit = (
+            self.shrink_grace_s > 0 and held_s >= self.shrink_grace_s
+        )
+        if strikes < SHRINK_STRIKES and not grace_hit:
             log.warning(
                 "%s snapshot lost %d of %d known; holding (strike "
-                "%d/%d) — truncated list response?",
-                kind, gone, known, strikes, SHRINK_STRIKES,
+                "%d/%d, held %.1fs of %.1fs grace) — truncated list "
+                "response?",
+                kind, gone, known, strikes, SHRINK_STRIKES, held_s,
+                self.shrink_grace_s,
             )
+            self.trace.emit(
+                "EVICTION_GUARD_HOLD", round_num=self.round_num,
+                detail={"kind": kind, "gone": gone, "known": known,
+                        "strike": strikes,
+                        "held_s": round(held_s, 3)},
+            )
+            if self.metrics is not None:
+                self.metrics.record_guard_hold(kind)
             return True
         log.warning(
-            "%s shrink persisted %d polls; accepting it as real",
-            kind, strikes,
+            "%s shrink persisted (%d polls, %.1fs); accepting it as "
+            "true death", kind, strikes, held_s,
+        )
+        self._guard_release(
+            kind, "accepted", gone=gone, known=known,
+            strikes=strikes, held_s=held_s,
         )
         setattr(self, counter, 0)
+        setattr(self, first_attr, 0.0)
         return False
 
     # ---- observation (the poll side) -----------------------------------
@@ -474,9 +565,31 @@ class SchedulerBridge:
         )
         return node.name
 
+    def _requeue_take(self) -> bool:
+        """Consume one unit of the per-round staged-requeue budget
+        (True = schedulable now, False = park for a later wave)."""
+        if self._requeue_budget_left is None:
+            return True
+        if self._requeue_budget_left > 0:
+            self._requeue_budget_left -= 1
+            return True
+        return False
+
     def _remove_node(self, name: str) -> None:
         """Release a machine: its Running tasks flip back to Pending
-        (they will be re-placed) and are logged as evictions."""
+        (they will be re-placed) and are logged as evictions.
+
+        Displacement is budget-staged: every displaced pod parks in
+        ``_displaced_parked`` and re-enters the schedulable set in
+        FIFO waves of at most ``max_migrations_per_round`` per
+        ``begin_round`` (shared across every node death — a rack loss
+        via N watch DELETED events drains exactly like one mass poll
+        shrink). Observe precedes begin in the tick, so a small
+        removal's pods are admitted the SAME tick they were displaced
+        — behavior is unchanged below the budget; above it, the storm
+        drains as bounded waves instead of one re-placement storm.
+        State truth is immediate (the pod IS Pending, the machine IS
+        gone); only the *re-placement rate* is bounded."""
         if name not in self.machines:
             return
         log.warning("node %s removed; evicting its tasks", name)
@@ -490,8 +603,10 @@ class SchedulerBridge:
                     task, phase=TaskPhase.PENDING, machine=""
                 )
                 self.pod_to_machine.pop(uid, None)
+                self._displaced_parked[uid] = None
                 self.trace.emit("EVICT", task=uid, machine=name,
-                                round_num=self.round_num)
+                                round_num=self.round_num,
+                                detail={"parked": True})
                 self._evictions_this_round += 1
 
     def observe_nodes(self, nodes: list[Machine]) -> None:
@@ -546,6 +661,11 @@ class SchedulerBridge:
         job/pref reshapes change arc structure mid-order)."""
         g = self._graph
         if not g:
+            return
+        if pod.uid in self._displaced_parked:
+            # parked displacement: the builder never saw this task
+            # (cluster_state excludes it), so no targeted note can
+            # apply — the admitted task carries its current shape
             return
         if known.job != pod.job or not (
             known.data_prefs is pod.data_prefs
@@ -639,6 +759,16 @@ class SchedulerBridge:
                 )
                 if known is not None else pod
             )
+            if pod.uid in self._displaced_parked:
+                # a parked displacement adopted Running on a live
+                # machine (external writer / node resurrection):
+                # unpark; the builder never saw the parked task, so
+                # targeted notes below cannot apply — one full
+                # rebuild covers the transition
+                del self._displaced_parked[pod.uid]
+                if g:
+                    g.note_full_rebuild("parked pod adopted running")
+                g = None
             if g:
                 if known is not None and known.phase == TaskPhase.PENDING:
                     g.note_task_removed(pod.uid)
@@ -1054,6 +1184,11 @@ class SchedulerBridge:
 
     def _retire_notes(self, task: Task) -> None:
         """Graph notes for a task leaving the cluster entirely."""
+        if task.uid in self._displaced_parked:
+            # retired while parked: the builder never saw it — no
+            # note; just release the parking slot
+            del self._displaced_parked[task.uid]
+            return
         g = self._graph
         if not g:
             return
@@ -1069,9 +1204,16 @@ class SchedulerBridge:
     # ---- the scheduling round ------------------------------------------
 
     def cluster_state(self) -> ClusterState:
+        tasks = list(self.tasks.values())
+        if self._displaced_parked:
+            # parked node-death displacement waits for its staged-
+            # requeue wave: excluded from the schedulable view (state
+            # truth — self.tasks — keeps them as Pending throughout)
+            parked = self._displaced_parked
+            tasks = [t for t in tasks if t.uid not in parked]
         return ClusterState(
             machines=list(self.machines.values()),
-            tasks=list(self.tasks.values()),
+            tasks=tasks,
         )
 
     def run_scheduler(self) -> RoundResult:
@@ -1159,6 +1301,39 @@ class SchedulerBridge:
                 tasks=self.tasks,
                 knowledge=self.knowledge,
             )
+        # staged-requeue wave: refresh the per-round displacement
+        # budget and admit the next FIFO wave of parked node-death
+        # displacement into the schedulable set (note_task_added —
+        # from the builder's view these ARE new pending arrivals)
+        self._requeue_budget_left = (
+            self.max_migrations_per_round
+            if self.max_migrations_per_round > 0 else None
+        )
+        admitted = 0
+        while self._displaced_parked:
+            uid = next(iter(self._displaced_parked))
+            task = self.tasks.get(uid)
+            if task is None or task.phase != TaskPhase.PENDING:
+                # moved on while parked (retired/adopted): discard
+                # WITHOUT burning a budget unit — a wave peppered
+                # with stale entries must still admit a full budget
+                # of real pods
+                del self._displaced_parked[uid]
+                continue
+            if not self._requeue_take():
+                break
+            del self._displaced_parked[uid]
+            # re-enter at the END of the insertion order: the builder
+            # appends admitted tasks to its pending order, and the
+            # cluster view must agree or the self-heal verify would
+            # force a full rebuild every admission wave
+            del self.tasks[uid]
+            self.tasks[uid] = task
+            if self._graph:
+                self._graph.note_task_added(task)
+            admitted += 1
+        stats.requeue_admitted = admitted
+        stats.displaced_parked = len(self._displaced_parked)
         t_start = time.perf_counter()
 
         cluster = self.cluster_state()
@@ -1657,6 +1832,12 @@ class SchedulerBridge:
             self.solver.invalidate_express()
         self._node_shrink_strikes = 0
         self._pod_shrink_strikes = 0
+        self._node_shrink_strikes_first = 0.0
+        self._pod_shrink_strikes_first = 0.0
+        # parking does not survive the process: restored pods are all
+        # schedulable at once (documented — at worst one placement
+        # burst after a crash mid-drain, bounded by what was parked)
+        self._displaced_parked = {}
 
     @property
     def solver_timeout_s(self) -> float:

@@ -374,6 +374,51 @@ def build_parser() -> argparse.ArgumentParser:
                         "every tick; a standby may take over after "
                         "this long without a renewal — keep it above "
                         "the polling period)")
+    # failure-domain survival (README "Failure handling",
+    # poseidon_tpu/ha/outbox.py + chaos/): the mass-eviction guard's
+    # NotReady grace exit, the apiserver-outage degradation ladder
+    # (actuation outbox + declared degraded=outage), and overload
+    # backpressure (round-deadline watchdog + express shed)
+    p.add_argument("--node_grace_s", type=float, default=45.0,
+                   help="NotReady grace window: a held implausible "
+                        "node/pod snapshot shrink that persists this "
+                        "many seconds is accepted as TRUE death (the "
+                        "mass-eviction guard's time exit; strikes "
+                        "still accept after 3 consecutive polls); "
+                        "displaced RUNNING pods then drain through "
+                        "the --max_migrations_per_round staged-"
+                        "requeue budget. 0 = strikes-only")
+    p.add_argument("--outage_threshold", type=int, default=3,
+                   help="consecutive apiserver transport failures "
+                        "(failed polls/LISTs, unreachable POSTs) "
+                        "before declaring the degraded=outage state: "
+                        "rounds keep solving from last-known state, "
+                        "actuations park in the outbox, /readyz and "
+                        "poseidon_outage surface the window. "
+                        "0 = never declare (the outbox still parks)")
+    p.add_argument("--outbox_dead_letter_s", type=float, default=120.0,
+                   help="an outboxed actuation older than this dead-"
+                        "letters loudly (OUTBOX_DEAD_LETTER trace + "
+                        "counter) and the pod re-queues with ONE "
+                        "aging bump; until then unreachable POSTs "
+                        "retry with jittered backoff instead of "
+                        "re-POST storms every round. 0 = age-"
+                        "unbounded (an attempt-cap backstop applies "
+                        "instead)")
+    p.add_argument("--round_deadline_ms", type=float, default=0.0,
+                   help="overload watchdog: a round whose wall span "
+                        "exceeds this is a counted deadline miss; "
+                        "two consecutive misses declare degraded="
+                        "overload (express windows shed to the tick "
+                        "path until a round meets the deadline). "
+                        "0 = off")
+    p.add_argument("--express_shed_queue", type=int, default=512,
+                   help="overload backpressure: when the pods watch "
+                        "queue holds more than this many undrained "
+                        "items, the express window sheds to the tick "
+                        "path (one full solve absorbs the burst) and "
+                        "poseidon_express_shed_total counts it. "
+                        "0 = never shed")
     return p
 
 
@@ -419,26 +464,37 @@ def parse_args(argv: list[str]) -> argparse.Namespace:
 
 
 def _post_bindings(client, bridge, bindings: dict[str, str],
-                   journal=None, seqs=None):
+                   journal=None, seqs=None, outbox=None):
     """POST bindings concurrently (bounded): serially, a 10k-placement
     round is 10k sequential HTTP round trips — the reference has the
     same flaw (one pplx chain joined per pod, k8s_api_client.cc:225).
-    Returns [(uid, machine, ok)]; the caller decides confirm/revoke
-    (the bridge is not thread-safe, so state changes stay on the main
+    Returns [(uid, machine, outcome)] with outcome in ok / rejected /
+    unreachable / parked; the caller decides confirm/revoke (the
+    bridge is not thread-safe, so state changes stay on the main
     thread). When an actuation journal rides along (``--checkpoint_
     dir``), each successful POST is marked ``posted`` — the caller
     must have journaled the intents (with their ``seqs``) BEFORE this
-    call, that ordering is the crash-consistency contract."""
+    call, that ordering is the crash-consistency contract. With an
+    ``outbox``, unreachable POSTs park there (outcome "parked"): the
+    pod stays confirmed, the journal intent stays open, and the
+    outbox pump owns the retries — the apiserver-outage ladder."""
     import concurrent.futures as _cf
 
     def _bind(item):
         uid, machine = item
         task = bridge.tasks.get(uid)
         ns = task.namespace if task else "default"
-        ok = client.bind_pod_to_node(uid, machine, namespace=ns)
-        if ok and journal is not None and seqs:
+        outcome = client.bind_outcome(uid, machine, namespace=ns)
+        if outcome == "ok" and journal is not None and seqs:
             journal.posted(seqs.get(("bind", uid), 0))
-        return uid, machine, ok
+        if outcome == "unreachable" and outbox is not None:
+            outbox.enqueue(
+                "bind", uid, machine=machine,
+                seq=(seqs or {}).get(("bind", uid), 0),
+                round_num=bridge.round_num,
+            )
+            outcome = "parked"
+        return uid, machine, outcome
 
     workers = min(16, len(bindings))
     with _cf.ThreadPoolExecutor(workers) as pool:
@@ -446,7 +502,8 @@ def _post_bindings(client, bridge, bindings: dict[str, str],
 
 
 def _actuate_rebalance(client, bridge, migrations, preemptions, *,
-                       confirm: bool, journal=None, seqs=None):
+                       confirm: bool, journal=None, seqs=None,
+                       outbox=None):
     """Actuate MIGRATE (evict + re-bind) and PREEMPT (evict) deltas.
 
     ``confirm=True`` is the serial contract (state changes only after
@@ -454,7 +511,10 @@ def _actuate_rebalance(client, bridge, migrations, preemptions, *,
     (the bridge already confirmed at finish time — failures restore the
     pod to its old machine and the next poll reconciles). Journaled
     like the bindings: intents must already be on disk; this marks
-    posted/confirmed/failed per delta.
+    posted/confirmed/failed per delta. With an ``outbox``, unreachable
+    POSTs park there (the decision stands, only the wire is broken):
+    the pod keeps its confirmed state, the journal intent stays open,
+    and the pump replays idempotently.
     """
     def _ns(uid):
         task = bridge.tasks.get(uid)
@@ -465,24 +525,42 @@ def _actuate_rebalance(client, bridge, migrations, preemptions, *,
             getattr(journal, phase)(seqs.get((kind, uid), 0))
 
     for uid, frm in preemptions.items():
-        if client.evict_pod(uid, namespace=_ns(uid)):
+        out = client.evict_outcome(uid, namespace=_ns(uid))
+        if out == "ok":
             _mark("evict", uid, "posted")
             if confirm:
                 bridge.confirm_preemption(uid)
             _mark("evict", uid, "confirmed")
+        elif out == "unreachable" and outbox is not None:
+            if confirm:
+                bridge.confirm_preemption(uid)
+            outbox.enqueue(
+                "evict", uid, from_machine=frm,
+                seq=(seqs or {}).get(("evict", uid), 0),
+                round_num=bridge.round_num,
+            )
         else:
             log.warning("eviction POST failed for %s; restoring", uid)
             _mark("evict", uid, "failed")
             bridge.restore_running(uid, frm)
     for uid, (frm, to) in migrations.items():
         ns = _ns(uid)
-        ok = client.evict_pod(uid, namespace=ns) and \
-            client.bind_pod_to_node(uid, to, namespace=ns)
-        if ok:
+        out = client.evict_outcome(uid, namespace=ns)
+        if out == "ok":
+            out = client.bind_outcome(uid, to, namespace=ns)
+        if out == "ok":
             _mark("migrate", uid, "posted")
             if confirm:
                 bridge.confirm_migration(uid, to)
             _mark("migrate", uid, "confirmed")
+        elif out == "unreachable" and outbox is not None:
+            if confirm:
+                bridge.confirm_migration(uid, to)
+            outbox.enqueue(
+                "migrate", uid, machine=to, from_machine=frm,
+                seq=(seqs or {}).get(("migrate", uid), 0),
+                round_num=bridge.round_num,
+            )
         else:
             log.warning("migration POSTs failed for %s; restoring", uid)
             _mark("migrate", uid, "failed")
@@ -494,6 +572,7 @@ def run_loop(
     stop_event: threading.Event | None = None,
     lease=None,
     preloaded=None,
+    round_hook=None,
 ) -> int:
     """The scheduling daemon loop.
 
@@ -505,7 +584,11 @@ def run_loop(
     is renewed every tick in HA mode — a failed renewal steps down
     with exit code 1 instead of scheduling against a lost lock.
     ``preloaded`` short-circuits the checkpoint read with a snapshot a
-    standby already followed into memory.
+    standby already followed into memory. ``round_hook`` (tests, the
+    chaos harness) is called on the driver thread after every
+    completed round with ``(rounds_completed, result)`` — the
+    deterministic injection seam: a seeded fault orchestrator can key
+    its schedule on exact round numbers instead of racing wall time.
     """
     logging.basicConfig(
         level=logging.INFO,
@@ -609,11 +692,69 @@ def run_loop(
         topk_prefs=args.topk_prefs,
         express_lane=args.express_lane == "true",
         express_max_batch=args.express_max_batch,
+        shrink_grace_s=args.node_grace_s,
         metrics=sched_metrics,
         profile_spans=args.trace_profile == "true",
         flightrec=flightrec,
         lifecycle=lifecycle,
         auditor=auditor,
+    )
+    # ---- the failure-domain ladder (README "Failure handling") --------
+    # actuation outbox: unreachable POSTs park with jittered backoff +
+    # a dead-letter bound instead of per-round re-POST storms; the
+    # outage detector declares degraded=outage at --outage_threshold
+    # consecutive transport failures (rounds keep solving from
+    # last-known state); the round-deadline watchdog declares
+    # degraded=overload on consecutive --round_deadline_ms misses
+    from poseidon_tpu.ha import ActuationOutbox, OutageDetector
+
+    def _outage_changed(active: bool) -> None:
+        bridge.trace.emit(
+            "OUTAGE", round_num=bridge.round_num,
+            detail={"phase": "begin" if active else "end",
+                    "outbox_pending": outbox.pending},
+        )
+        bridge.trace.flush()
+        if sched_metrics is not None:
+            sched_metrics.record_outage(active)
+        if health is not None:
+            health.set_degraded("outage", active)
+
+    def _outbox_settled(entry, outcome: str) -> None:
+        # the parked actuation landed (or was already visible): close
+        # its journal intent; bridge state was confirmed at decision
+        # time, so nothing moves here
+        if journal is not None and entry.seq:
+            journal.confirmed(entry.seq)
+
+    def _outbox_dead(entry) -> None:
+        # the wire never healed for this op: give the pod back to the
+        # normal failure paths — ONE aging bump for the whole outage
+        if journal is not None and entry.seq:
+            journal.failed(entry.seq)
+        bridge.trace.emit(
+            "OUTBOX_DEAD_LETTER", task=entry.uid,
+            machine=entry.machine, round_num=bridge.round_num,
+            detail={"op": entry.op, "attempts": entry.attempts,
+                    "from": entry.from_machine},
+        )
+        bridge.trace.flush()
+        if entry.op == "bind":
+            bridge.binding_failed(entry.uid)
+        else:  # evict/migrate: apiserver's last-known truth wins
+            bridge.restore_running(entry.uid, entry.from_machine)
+
+    outbox = ActuationOutbox(
+        client,
+        dead_letter_s=args.outbox_dead_letter_s,
+        metrics=sched_metrics,
+        on_settled=_outbox_settled,
+        on_dead_letter=_outbox_dead,
+    )
+    detector = OutageDetector(
+        max(args.outage_threshold, 1), on_change=_outage_changed,
+    ) if args.outage_threshold > 0 else OutageDetector(
+        threshold=1_000_000_000  # never declares; outbox still parks
     )
     # the SLO engine reads its sources from the metrics registry and
     # emits SLO_BREACH into the bridge's trace stream
@@ -759,15 +900,31 @@ def run_loop(
     except ValueError:
         pass  # not the main thread: embedded drivers own their signals
 
+    def _note_read_success() -> None:
+        """A read (poll/LIST) succeeded. That proves the READ path
+        only: while actuations are still parked in the outbox, the
+        outage is not over (reads-OK/writes-down apiservers exist —
+        e.g. etcd write quorum lost) — clearing here would flap the
+        declared state and mint one episode per round. A successful
+        WRITE-path interaction (a POST landing, a pump settle) clears
+        unconditionally via detector.note_success at its own sites."""
+        if outbox.pending == 0:
+            detector.note_success()
+
     def _observe_tick() -> bool:
-        """One tick's cluster observation; False = skip the tick."""
+        """One tick's cluster observation; False = skip the tick
+        (unless an outage is declared — then the loop keeps rounding
+        from last-known state). Feeds the outage detector: every real
+        apiserver interaction counts, success or transport failure."""
         if watcher is None:
             try:
                 nodes = client.all_nodes()
                 pods = client.all_pods()
             except ApiError as e:
                 log.error("poll failed, skipping tick: %s", e)
+                detector.note_failure()
                 return False
+            _note_read_success()
             bridge.observe_nodes(nodes)
             bridge.observe_pods(pods)
             return True
@@ -775,10 +932,14 @@ def run_loop(
             delta = watcher.tick()
         except ApiError as e:
             log.error("watch sync failed, skipping tick: %s", e)
+            detector.note_failure()
             return False
         if delta.resynced:
-            # full snapshot: replay the poll-diff path (mass-eviction
+            # a resync performed real LISTs successfully (plain event
+            # drains are stream reads, detector-neutral); full
+            # snapshot: replay the poll-diff path (mass-eviction
             # guard included)
+            _note_read_success()
             bridge.observe_nodes(delta.nodes)
             bridge.observe_pods(delta.pods)
         else:
@@ -835,28 +996,39 @@ def run_loop(
         ]
         return journal.intents(ops, bridge.round_num)
 
-    def _mark_bind(seqs, uid, ok) -> None:
-        if lifecycle is not None and ok:
-            # stamped on the driver thread as each pool result is
-            # consumed (the tracker is driver-thread-only); a no-op
-            # for timelines the optimistic confirm already closed
-            lifecycle.stamp(uid, "posted")
+    def _mark_bind(seqs, uid, outcome: str) -> None:
+        """Journal/lifecycle marks for one pool result ("ok" /
+        "rejected" / "parked" — a parked bind's intent stays OPEN:
+        the outbox pump closes it when the wire heals)."""
+        if outcome == "parked":
+            detector.note_failure()
+            return
+        if outcome == "ok":
+            detector.note_success()
+            if lifecycle is not None:
+                # stamped on the driver thread as each pool result is
+                # consumed (the tracker is driver-thread-only); a
+                # no-op for timelines the optimistic confirm closed
+                lifecycle.stamp(uid, "posted")
         if journal is not None and seqs:
             seq = seqs.get(("bind", uid), 0)
-            (journal.confirmed if ok else journal.failed)(seq)
+            (journal.confirmed if outcome == "ok"
+             else journal.failed)(seq)
 
     def _post_express(result) -> None:
-        """POST one express batch's bindings; failures re-queue (the
+        """POST one express batch's bindings; rejections re-queue (the
         bridge invalidates the context, so the next full round owns
-        recovery)."""
+        recovery); unreachable POSTs park in the outbox with the pod
+        confirmed."""
         if result is None or not result.bindings:
             return
         seqs = _bind_seqs(result.bindings)
-        for uid, machine, ok in _post_bindings(
-            client, bridge, result.bindings, journal=journal, seqs=seqs
+        for uid, machine, outcome in _post_bindings(
+            client, bridge, result.bindings, journal=journal,
+            seqs=seqs, outbox=outbox,
         ):
-            _mark_bind(seqs, uid, ok)
-            if ok:
+            _mark_bind(seqs, uid, outcome)
+            if outcome in ("ok", "parked"):
                 bridge.confirm_binding(uid, machine)
             else:
                 log.warning(
@@ -878,8 +1050,20 @@ def run_loop(
             if wait <= 0:
                 return
             ev = watcher.express_poll(
-                wait, max_events=args.express_max_batch
+                wait, max_events=args.express_max_batch,
+                shed_queue=args.express_shed_queue,
             )
+            if ev.shed:
+                # overload backpressure: the queued burst outgrew the
+                # express lane — loudly hand it to the tick's single
+                # full solve
+                log.warning(
+                    "express window shed to tick: pods stream queue "
+                    "exceeds --express_shed_queue=%d",
+                    args.express_shed_queue,
+                )
+                if sched_metrics is not None:
+                    sched_metrics.record_express_shed()
             if ev.reconnects:
                 bridge.note_watch_activity(0, ev.reconnects)
             if ev.pod_events:
@@ -918,6 +1102,7 @@ def run_loop(
 
     def _log_round(result):
         s = result.stats
+        s.outbox_pending = outbox.pending
         log.info(
             "round %d: pending=%d placed=%d unsched=%d cost=%d "
             "backend=%s build=%s solve=%.1fms total=%.1fms "
@@ -931,14 +1116,16 @@ def run_loop(
             stats_fh.flush()
 
     def _post_and_revoke(to_post, seqs):
-        """POST optimistically-confirmed bindings; failures re-queue
+        """POST optimistically-confirmed bindings; rejections re-queue
         the pod as unscheduled (counted in SchedulerStats) so it is
-        re-offered next round."""
-        for uid, machine, ok in _post_bindings(
-            client, bridge, to_post, journal=journal, seqs=seqs
+        re-offered next round; unreachable POSTs park in the outbox
+        (the pod stays confirmed — outage semantics)."""
+        for uid, machine, outcome in _post_bindings(
+            client, bridge, to_post, journal=journal, seqs=seqs,
+            outbox=outbox,
         ):
-            _mark_bind(seqs, uid, ok)
-            if not ok:
+            _mark_bind(seqs, uid, outcome)
+            if outcome not in ("ok", "parked"):
                 log.warning("bind POST failed for %s; re-queueing", uid)
                 bridge.binding_failed(uid)
 
@@ -953,6 +1140,7 @@ def run_loop(
             _actuate_rebalance(
                 client, bridge, to_rebal[0], to_rebal[1],
                 confirm=False, journal=journal, seqs=to_rebal_seqs,
+                outbox=outbox,
             )
             to_rebal = ({}, {})
             to_rebal_seqs = {}
@@ -1003,11 +1191,55 @@ def run_loop(
         else:
             ckpt_mgr.submit(snap)
 
+    # overload watchdog state: consecutive round-deadline misses
+    # (>= 2 declares degraded=overload; a met deadline clears it)
+    deadline_misses = 0
+    overloaded = False
+
+    def _watchdog(stats) -> None:
+        """Round-deadline watchdog: degrade (declared overload state,
+        express windows shed to tick) rather than wedge."""
+        nonlocal deadline_misses, overloaded
+        if args.round_deadline_ms <= 0:
+            return
+        if stats.wall_ms > args.round_deadline_ms:
+            deadline_misses += 1
+            bridge.trace.emit(
+                "ROUND_DEADLINE_MISS", round_num=stats.round_num,
+                detail={"wall_ms": round(stats.wall_ms, 3),
+                        "deadline_ms": args.round_deadline_ms,
+                        "consecutive": deadline_misses},
+            )
+            bridge.trace.flush()
+            if deadline_misses >= 2 and not overloaded:
+                overloaded = True
+                log.warning(
+                    "round deadline missed %d times in a row "
+                    "(%.1fms > %.1fms); declaring degraded=overload "
+                    "— express windows shed to the tick path",
+                    deadline_misses, stats.wall_ms,
+                    args.round_deadline_ms,
+                )
+                if health is not None:
+                    health.set_degraded("overload", True)
+            if sched_metrics is not None:
+                sched_metrics.record_deadline_miss(overloaded)
+        else:
+            deadline_misses = 0
+            if overloaded:
+                overloaded = False
+                log.info("round met its deadline; overload cleared")
+                if health is not None:
+                    health.set_degraded("overload", False)
+                if sched_metrics is not None:
+                    sched_metrics.record_overload_cleared()
+
     def _round_done(result, flush):
         """Log + count one completed round; True = max_rounds reached
         (any not-yet-POSTed deltas are flushed before exiting)."""
         nonlocal rounds
         _log_round(result)
+        _watchdog(result.stats)
         if health is not None:
             # /readyz flips once a round over real observed state
             # landed — proven-empty counts (the latch updates the
@@ -1023,6 +1255,10 @@ def run_loop(
             # windows are measured in rounds)
             slo_engine.evaluate(result.stats.round_num)
         rounds += 1
+        if round_hook is not None:
+            # deterministic injection seam (chaos harness, tests):
+            # runs on the driver thread between rounds
+            round_hook(rounds, result)
         if ckpt_mgr is not None:
             ckpt_mgr.record_age()
             if rounds % max(args.checkpoint_every, 1) == 0:
@@ -1057,6 +1293,21 @@ def run_loop(
                         bridge.cancel_round(inflight)
                         inflight = None
                 _flush_pending()
+                if outbox.pending:
+                    # one immediate best-effort drain (backoff
+                    # ignored: the process is leaving). Whatever
+                    # stays parked is covered by the open journal
+                    # intents — the next boot replays them
+                    # idempotently; without a journal the loss is
+                    # loud, not silent.
+                    outbox.pump(force=True)
+                    if outbox.pending or journal is None:
+                        log.warning(
+                            "exiting with %d actuation(s) parked in "
+                            "the outbox%s", outbox.pending,
+                            "" if journal is not None else
+                            " and NO journal to replay them",
+                        )
                 return 0
             if lease is not None and not lease.renew():
                 # leadership lost (partition / apiserver-side expiry):
@@ -1064,10 +1315,25 @@ def run_loop(
                 log.error("lease renewal failed; stepping down")
                 return 1
             tick_start = time.perf_counter()
-            if not _observe_tick():
+            if outbox.pending:
+                # retry parked actuations (jittered backoff per
+                # entry; one probe failure aborts the pump — a down
+                # apiserver is not hammered once per entry). A settle
+                # proves the apiserver reachable again.
+                counts = outbox.pump()
+                if (counts["replayed"] or counts["already-applied"]
+                        or counts["stale"]):
+                    detector.note_success()
+            observed = _observe_tick()
+            if not observed and not detector.active:
                 time.sleep(args.polling_frequency / 1e6)
                 continue
-            if health is not None:
+            # declared outage: keep rounding from last-known state
+            # (the round is usually empty — everything decided is
+            # confirmed — but readiness, SLO evaluation, and the
+            # time-to-recovery clock stay live, and recovery needs no
+            # warmup round)
+            if observed and health is not None:
                 # the seed LIST / first successful snapshot is applied
                 health.mark_seeded()
             if not incremental and not pipelined:
@@ -1119,12 +1385,17 @@ def run_loop(
                             result.migrations, result.preemptions
                         )
                         if result.bindings:
-                            for uid, machine, ok in _post_bindings(
+                            for uid, machine, outcome in _post_bindings(
                                 client, bridge, result.bindings,
                                 journal=journal, seqs=seqs,
+                                outbox=outbox,
                             ):
-                                _mark_bind(seqs, uid, ok)
-                                if ok:
+                                _mark_bind(seqs, uid, outcome)
+                                if outcome in ("ok", "parked"):
+                                    # parked: confirm optimistically —
+                                    # the decision stands, the outbox
+                                    # owns the wire (a dead-letter
+                                    # revokes + re-queues later)
                                     bridge.confirm_binding(uid, machine)
                                 else:
                                     bridge.binding_failed(uid)
@@ -1133,6 +1404,7 @@ def run_loop(
                                 client, bridge, result.migrations,
                                 result.preemptions, confirm=True,
                                 journal=journal, seqs=rebal_seqs,
+                                outbox=outbox,
                             )
                         if _round_done(result, False):
                             return 0
@@ -1155,9 +1427,11 @@ def run_loop(
                 continue
             elapsed = time.perf_counter() - tick_start
             remaining = max(args.polling_frequency / 1e6 - elapsed, 0.0)
-            if express and remaining > 0:
+            if express and remaining > 0 and not overloaded:
                 # the inter-tick sleep IS the express window: block on
-                # the pods watch stream and bind arrivals immediately
+                # the pods watch stream and bind arrivals immediately.
+                # Declared overload skips the window entirely — the
+                # tick path absorbs the backlog in one solve.
                 _express_window(remaining)
             else:
                 time.sleep(remaining)

@@ -15,7 +15,12 @@ the watch resourceVersion). This package makes restarts survivable:
   wire, so a restart replays incomplete actuations idempotently and
   never double-binds or loses a placement the apiserver accepted;
 - ``standby.py``: Lease-style leader election + a warm standby that
-  follows checkpoints and takes over without a cold start.
+  follows checkpoints and takes over without a cold start;
+- ``outbox.py``: the apiserver-outage degradation ladder — an
+  actuation outbox parks unreachable bind/evict POSTs with jittered
+  backoff + a dead-letter bound (instead of per-round re-POST storms
+  and distorted wait-aging), and an outage detector declares the
+  ``degraded=outage`` state rounds keep solving through.
 """
 
 from poseidon_tpu.ha.checkpoint import (
@@ -25,13 +30,21 @@ from poseidon_tpu.ha.checkpoint import (
     restore_bridge,
 )
 from poseidon_tpu.ha.journal import ActuationJournal, replay_journal
+from poseidon_tpu.ha.outbox import (
+    ActuationOutbox,
+    OutageDetector,
+    OutboxEntry,
+)
 from poseidon_tpu.ha.standby import LeaderElector
 
 __all__ = [
     "ActuationJournal",
+    "ActuationOutbox",
     "CheckpointManager",
     "CheckpointSnapshot",
     "LeaderElector",
+    "OutageDetector",
+    "OutboxEntry",
     "load_latest",
     "replay_journal",
     "restore_bridge",

@@ -545,6 +545,71 @@ class SchedulerMetrics:
             "reason (degrade/express-degrade/fetch-timeout/"
             "resync-storm/manual)",
         )
+        # ---- failure-domain survival (ISSUE 15) ----
+        self.guard_holds = registry.counter(
+            "poseidon_eviction_guard_holds_total",
+            "mass-eviction guard holds (an implausible >50% snapshot "
+            "shrink held pending strikes/grace), by kind (node/pod)",
+        )
+        self.guard_releases = registry.counter(
+            "poseidon_eviction_guard_releases_total",
+            "mass-eviction guard releases, by kind and outcome "
+            "(accepted = honored as true death after the strike/grace "
+            "bound; recovered = the snapshot healed mid-hold)",
+        )
+        self.guard_active = registry.gauge(
+            "poseidon_eviction_guard_active",
+            "1 while the mass-eviction guard is holding a shrink, by "
+            "kind (node/pod)",
+        )
+        self.outage = registry.gauge(
+            "poseidon_outage",
+            "1 while the apiserver-outage degradation ladder is "
+            "active (consecutive transport failures crossed "
+            "--outage_threshold); rounds keep solving from last-known "
+            "state, POSTs park in the actuation outbox",
+        )
+        self.outage_episodes = registry.counter(
+            "poseidon_outage_episodes_total",
+            "apiserver outage windows entered (ONE per window, "
+            "however many POSTs/polls failed inside it)",
+        )
+        self.outbox_pending = registry.gauge(
+            "poseidon_outbox_pending",
+            "actuations parked in the outbox awaiting a reachable "
+            "apiserver",
+        )
+        self.outbox_retries = registry.counter(
+            "poseidon_outbox_retries_total",
+            "outbox retry attempts (jittered backoff per entry)",
+        )
+        self.outbox_settled = registry.counter(
+            "poseidon_outbox_settled_total",
+            "outboxed actuations settled, by outcome (replayed/"
+            "already-applied/stale)",
+        )
+        self.outbox_dead_letters = registry.counter(
+            "poseidon_outbox_dead_letters_total",
+            "outboxed actuations that exhausted their retry budget "
+            "(pod re-queued through binding_failed), by op",
+        )
+        self.express_shed = registry.counter(
+            "poseidon_express_shed_total",
+            "express windows shed to the tick path because the pods "
+            "stream queue exceeded --express_shed_queue (overload "
+            "backpressure: the full round absorbs the burst)",
+        )
+        self.round_deadline_misses = registry.counter(
+            "poseidon_round_deadline_misses_total",
+            "rounds whose wall span exceeded --round_deadline_ms "
+            "(the overload watchdog)",
+        )
+        self.overload = registry.gauge(
+            "poseidon_overload",
+            "1 while consecutive round-deadline misses have declared "
+            "degraded=overload (express windows shed to the tick "
+            "path); cleared by a round meeting the deadline",
+        )
         # ---- crash safety / HA (poseidon_tpu/ha/) ----
         self.checkpoint_bytes = registry.gauge(
             "poseidon_checkpoint_bytes",
@@ -708,6 +773,60 @@ class SchedulerMetrics:
     def record_restore(self) -> None:
         self.restores.inc()
 
+    # ---- failure-domain survival (ISSUE 15) ----------------------------
+
+    def record_guard_hold(self, kind: str) -> None:
+        """One mass-eviction-guard hold (bridge observe path; kind is
+        the bridge's own node/pod vocabulary — folded for safety)."""
+        kind = kind if kind in ("node", "pod") else "other"
+        self.guard_holds.inc(kind=kind)
+        self.guard_active.set(1, kind=kind)
+
+    def record_guard_release(self, kind: str, outcome: str) -> None:
+        kind = kind if kind in ("node", "pod") else "other"
+        outcome = outcome if outcome in ("accepted", "recovered") \
+            else "other"
+        self.guard_releases.inc(kind=kind, outcome=outcome)
+        self.guard_active.set(0, kind=kind)
+
+    def record_outage(self, active: bool) -> None:
+        """The outage ladder flipped (ONE episode tick per entry)."""
+        self.outage.set(1 if active else 0)
+        if active:
+            self.outage_episodes.inc()
+
+    def record_outbox(
+        self, pending: int, *, retries: int = 0, settled: str = "",
+        dead_letter_op: str = "",
+    ) -> None:
+        """Outbox bookkeeping after a pump/enqueue (host ints the
+        outbox already holds)."""
+        self.outbox_pending.set(pending)
+        if retries:
+            self.outbox_retries.inc(retries)
+        if settled:
+            outcome = settled if settled in (
+                "replayed", "already-applied", "stale"
+            ) else "other"
+            self.outbox_settled.inc(outcome=outcome)
+        if dead_letter_op:
+            op = dead_letter_op if dead_letter_op in (
+                "bind", "evict", "migrate"
+            ) else "other"
+            self.outbox_dead_letters.inc(op=op)
+
+    def record_express_shed(self) -> None:
+        self.express_shed.inc()
+
+    def record_deadline_miss(self, overloaded: bool) -> None:
+        """One round-deadline miss; ``overloaded`` is the watchdog's
+        current degraded-state verdict."""
+        self.round_deadline_misses.inc()
+        self.overload.set(1 if overloaded else 0)
+
+    def record_overload_cleared(self) -> None:
+        self.overload.set(0)
+
     # ---- the quality observatory ---------------------------------------
 
     def record_pod_e2c(self, e2c_ms: float, lane: str) -> None:
@@ -827,8 +946,13 @@ class SchedulerMetrics:
     def record_resync(self, reason: str) -> None:
         self.watch_resyncs.inc(reason=resync_reason_label(reason))
 
-    def record_reconnect(self, resource: str) -> None:
-        self.watch_reconnects.inc(resource=resource_label(resource))
+    def record_reconnect(self, resource: str, amount: int = 1) -> None:
+        """``amount`` > 1 folds a stream's coalesced (queue-
+        suppressed) reconnects in one increment (watch.py outage
+        bounding)."""
+        self.watch_reconnects.inc(
+            amount, resource=resource_label(resource)
+        )
 
     # ---- resident solver ----------------------------------------------
 

@@ -25,7 +25,11 @@ the discipline). Endpoints:
   an operator can tell "waiting for the apiserver" from "waiting for
   the first solve". A 200 body carries the ``restored_warm`` condition
   detail when the daemon rehydrated from a checkpoint at startup
-  (ha/checkpoint.py) — informational, never a gate. Degraded-to-oracle
+  (ha/checkpoint.py), and ``degraded=outage,overload`` while the
+  failure-domain ladder has declared a degraded window (apiserver
+  unreachable / round-deadline watchdog tripping) — informational,
+  never a gate: a degraded scheduler is still scheduling from
+  last-known state. Degraded-to-oracle
   and resync-storm states are NOT
   readiness failures — they surface as labeled gauges
   (``poseidon_degraded{why=...}``, ``poseidon_watch_resync_storm``)
@@ -76,6 +80,13 @@ class HealthState:
         # startup (ha/checkpoint.py) — "did this pod cold-start or
         # warm-restore" is the first rollout question after a bounce
         self._restored_warm = False
+        # declared degraded modes (the failure-domain ladder:
+        # "outage" while the apiserver is unreachable, "overload"
+        # while the round-deadline watchdog is tripping). NEVER a
+        # readiness gate — a degraded scheduler is still scheduling —
+        # but surfaced in the 200 body so a rollout can tell a
+        # healthy pod from one riding out an incident.
+        self._degraded: set[str] = set()
         self._gauge = ready_gauge
         if ready_gauge is not None:
             ready_gauge.set(0)
@@ -116,6 +127,20 @@ class HealthState:
     def restored_warm(self) -> bool:
         with self._lock:
             return self._restored_warm
+
+    def set_degraded(self, mode: str, active: bool) -> None:
+        """Declare or clear a degraded mode ("outage", "overload").
+        Informational: /readyz stays 200, the body carries
+        ``degraded=<modes>``."""
+        with self._lock:
+            if active:
+                self._degraded.add(mode)
+            else:
+                self._degraded.discard(mode)
+
+    def degraded_modes(self) -> list[str]:  # pta: background-thread
+        with self._lock:
+            return sorted(self._degraded)
 
     @property
     def ready(self) -> bool:
@@ -208,13 +233,19 @@ class ObsServer:
                                          "application/json")
                 elif route == "/readyz":
                     if health.ready:
-                        # condition detail: did this process warm-
-                        # restore from a checkpoint or cold-start?
-                        body = (
-                            b"ready restored_warm=true\n"
-                            if health.restored_warm
-                            else b"ready\n"
-                        )
+                        # condition details: did this process warm-
+                        # restore, and is it riding out a declared
+                        # degraded window (outage/overload)? Both
+                        # informational, never gates.
+                        parts = ["ready"]
+                        if health.restored_warm:
+                            parts.append("restored_warm=true")
+                        modes = health.degraded_modes()
+                        if modes:
+                            parts.append(
+                                "degraded=" + ",".join(modes)
+                            )
+                        body = (" ".join(parts) + "\n").encode()
                         self.send_response(200)
                     else:
                         body = (

@@ -24,7 +24,9 @@ error budget (``p99`` = 1% of observations may violate the threshold,
 percentile objective as a good/bad-event ratio, which is what makes
 multi-window burn rates well-defined. Thresholds snap DOWN to the
 nearest histogram bucket edge (documented, deterministic; buckets are
-fixed at registration). Gauge sources (``regret``, ``ready``)
+fixed at registration). Gauge sources (``regret``, ``ready``,
+``drift_pods``, and the failure-domain ladder's ``outage`` /
+``overload`` — write ``outage == 0``: their healthy value is 0)
 contribute one good/bad event per evaluation with a
 ``GAUGE_BUDGET`` (1%) budget. A ``by`` filter matches labelsets whose
 matching keys agree; a key the instrument never carries matches all
@@ -84,6 +86,12 @@ GAUGE_SOURCES = {
     "regret": ("poseidon_audit_regret", False),
     "ready": ("poseidon_ready", True),
     "drift_pods": ("poseidon_audit_drift_pods", False),
+    # the failure-domain degradation ladder: 'outage == 0' /
+    # 'overload == 0' alert on sustained degraded windows (non-bool:
+    # the healthy value is 0, so the bare-name boolean default of
+    # "== 1 is good" would invert them)
+    "outage": ("poseidon_outage", False),
+    "overload": ("poseidon_overload", False),
 }
 
 # error budget for gauge objectives (1 sample per evaluation): 1% of

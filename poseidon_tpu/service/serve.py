@@ -179,10 +179,10 @@ def run_serve(args: argparse.Namespace) -> int:
             session = service.sessions[tid]
             client = clients[tid]
             if result.bindings:
-                for uid, machine, ok in _post_bindings(
+                for uid, machine, outcome in _post_bindings(
                     client, session.bridge, result.bindings
                 ):
-                    if ok:
+                    if outcome == "ok":
                         session.bridge.confirm_binding(uid, machine)
                     else:
                         log.warning(

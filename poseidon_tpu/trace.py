@@ -29,6 +29,14 @@ RESTORE (the daemon rehydrated from a checkpoint at startup —
 ``detail.warm`` says whether the solve seed survived) and
 JOURNAL_REPLAY (an incomplete journaled actuation replayed
 idempotently on restart, ``detail.op``/``detail.outcome``),
+The failure-domain layer (ISSUE 15) adds EVICTION_GUARD_HOLD /
+EVICTION_GUARD_RELEASE (the mass-eviction guard holding or releasing
+an implausible snapshot shrink — release ``detail.outcome`` is
+"accepted" true-death or "recovered"), OUTAGE (the apiserver-outage
+degradation ladder flipping, ``detail.phase`` begin/end),
+OUTBOX_DEAD_LETTER (an outboxed actuation exhausted its retry budget)
+and ROUND_DEADLINE_MISS (the overload watchdog: a round's wall span
+exceeded ``--round_deadline_ms``),
 plus ROUND records carrying the per-phase timing/stat payload
 (``SchedulerStats`` as a dict — including the round-pipeline timers:
 ``build_mode`` delta/full/legacy, ``dispatch_ms``, ``fetch_wait_ms``,
@@ -112,6 +120,33 @@ EVENT_TYPES = frozenset({
                         # detail.slo names the objective spec,
                         # detail.burn_short/burn_long the rates —
                         # emitted exactly once per breach window)
+    "EVICTION_GUARD_HOLD",     # the mass-eviction guard held an
+                               # implausible snapshot shrink
+                               # (detail.kind node|pod, detail.gone/
+                               # known/strike)
+    "EVICTION_GUARD_RELEASE",  # the guard released: detail.outcome is
+                               # "accepted" (the shrink persisted past
+                               # the strike/grace bound and was honored
+                               # as true death; the displaced-pod
+                               # staging shows up as EVICT events and
+                               # SchedulerStats.requeue_admitted/
+                               # displaced_parked) or "recovered" (the
+                               # snapshot healed); detail carries kind/
+                               # gone/known/strikes/held_s
+    "OUTAGE",           # the apiserver-outage ladder flipped: detail.
+                        # phase is "begin" (consecutive transport
+                        # failures crossed --outage_threshold; rounds
+                        # keep solving from last-known state, POSTs
+                        # park in the actuation outbox) or "end"
+                        # (first success; the outbox replays)
+    "OUTBOX_DEAD_LETTER",  # an outboxed actuation exhausted its retry
+                           # budget (detail.op/uid/attempts); the pod
+                           # is re-queued through binding_failed
+    "ROUND_DEADLINE_MISS",  # a round's wall span exceeded
+                            # --round_deadline_ms (detail.wall_ms);
+                            # consecutive misses declare
+                            # degraded=overload and shed the express
+                            # window to the tick path
 })
 
 

@@ -85,6 +85,8 @@ _OBS_HOT_SCOPES = {
         "SchedulerMetrics.record_reconnect",
         "SchedulerMetrics.record_solver_round",
         "SchedulerMetrics.record_express_fetch",
+        "SchedulerMetrics.record_stream_fetch",
+        "SchedulerMetrics.record_stream_flush",
         "SchedulerMetrics.record_service_round",
         "SchedulerMetrics.record_service_dispatch",
         "SchedulerMetrics.record_service_compiles",
@@ -106,6 +108,7 @@ _OBS_HOT_SCOPES = {
     "poseidon_tpu/obs/spans.py": (
         "round_span_tree",
         "express_span_tree",
+        "stream_span_tree",
         "emit_span",
     ),
 }
@@ -205,6 +208,9 @@ DEFAULT_CONTRACTS = Contracts(
             "SchedulerBridge.begin_round",
             "SchedulerBridge.finish_round",
             "SchedulerBridge.express_batch",
+            "SchedulerBridge.stream_window",
+            "SchedulerBridge.stream_flush",
+            "SchedulerBridge.stream_finish",
             "SchedulerBridge._express_transitions",
         ),
         # the scale lane: aggregation planning/expansion runs inside
@@ -234,7 +240,8 @@ DEFAULT_CONTRACTS = Contracts(
             "TenantSolver.finish_round",
             "BatchDispatcher.register",
             "BatchDispatcher.launch",
-            "BatchDispatcher._launch_chunk",
+            "BatchDispatcher._stage_chunk",
+            "BatchDispatcher._dispatch_chunk",
             "BatchDispatcher.finish",
         ),
         # the front door pipeline: pure host bookkeeping (queues,
@@ -278,6 +285,8 @@ DEFAULT_CONTRACTS = Contracts(
         "_redensify",
         "_finalize",
         "_express_chain",
+        "_express_step",
+        "_stream_chain",
         "_express_patch",
         "_solve",
         "_solve_member",
@@ -297,6 +306,9 @@ DEFAULT_CONTRACTS = Contracts(
             "SchedulerBridge.begin_round",
             "SchedulerBridge.finish_round",
             "SchedulerBridge.express_batch",
+            "SchedulerBridge.stream_window",
+            "SchedulerBridge.stream_flush",
+            "SchedulerBridge.stream_finish",
             "SchedulerBridge._express_transitions",
         ),
         "poseidon_tpu/graph/builder.py": (
@@ -307,6 +319,12 @@ DEFAULT_CONTRACTS = Contracts(
             "ResidentSolver.begin_round",
             "ResidentSolver.finish_round",
             "ResidentSolver.express_round",
+            # the stream lane runs per event WINDOW between ticks,
+            # same latency budget as the express fast path
+            "ResidentSolver.stream_window",
+            "ResidentSolver.stream_flush",
+            "ResidentSolver.stream_finish",
+            "ResidentSolver._stream_apply_freeze",
             # the express context's lazy host-map build: its two
             # deliberate O(T) walks carry reasoned suppressions (the
             # suppression audit proved the previous scope omission
@@ -322,7 +340,8 @@ DEFAULT_CONTRACTS = Contracts(
             "TenantSolver.finish_round",
             "BatchDispatcher.register",
             "BatchDispatcher.launch",
-            "BatchDispatcher._launch_chunk",
+            "BatchDispatcher._stage_chunk",
+            "BatchDispatcher._dispatch_chunk",
             "BatchDispatcher.finish",
         ),
         "poseidon_tpu/service/service.py": (

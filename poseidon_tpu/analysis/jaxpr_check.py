@@ -31,7 +31,8 @@ contracts directly on the jaxprs:
 
 Audited kernels (the production set): ``_solve`` (the eps-ladder
 auction), ``_resident_chain`` (the whole fused round),
-``_express_patch`` + ``_express_chain`` (the express lane), and
+``_express_patch`` + ``_express_chain`` (the express lane),
+``_stream_chain`` (the K-window streaming scan), and
 ``_solve_member`` (the service lane's bucket-member solve). The
 fingerprint is a property of the TRACE, not the backend: the 8-device
 CI lane re-runs the audit to prove the SPMD path sees the same
@@ -258,6 +259,21 @@ def trace_production_kernels() -> dict[str, object]:
     add_pm = np.full((kmax, pk), -1, np.int32)
     add_pr = np.full((kmax, pk), -1, np.int32)
 
+    # the stream lane's [K, ...] event buffers: the same per-window
+    # slices the synced lane takes, stacked along the batch axis (K=2
+    # is enough — scan length never changes the traced program)
+    skw = 2
+    mini_stack = jax.tree_util.tree_map(
+        lambda leaf: np.stack([np.asarray(leaf)] * skw), mini_host
+    )
+    add_row_s = np.stack([add_row] * skw)
+    add_pm_s = np.stack([add_pm] * skw)
+    add_pr_s = np.stack([add_pr] * skw)
+    spw = solver._stream_pw_floor
+    prow_s = np.full((skw, spw), -1, np.int32)
+    pcol_s = np.full((skw, spw), -1, np.int32)
+    pdelta_s = np.zeros((skw, spw), np.int32)
+
     # the service lane's stacked member tables (2 heterogeneous
     # members through the same scale-and-pad source production uses)
     topo = extract_topology(
@@ -328,6 +344,23 @@ def trace_production_kernels() -> dict[str, object]:
                 ctx.dev, ctx.dt, ctx.cost_dev, mini_host,
                 warm.asg, warm.lvl, warm.floor,
                 add_row, add_pm, add_pr,
+            ),
+            "stream_chain": jax.make_jaxpr(
+                lambda dev, dt, cost, mini, a, l, f, ar, pm, pr,
+                prw, pcl, pdl:
+                res._stream_chain(
+                    dev, dt, cost, mini, a, l, f, ar, pm, pr,
+                    prw, pcl, pdl,
+                    model_fn=model_fn, kmax=kmax, pk=pk,
+                    alpha=solver.alpha, max_rounds=res.EXPRESS_FUSE,
+                    smax=ctx.smax,
+                    change_cap=solver.express_change_cap,
+                )
+            )(
+                ctx.dev, ctx.dt, ctx.cost_dev, mini_stack,
+                warm.asg, warm.lvl, warm.floor,
+                add_row_s, add_pm_s, add_pr_s,
+                prow_s, pcol_s, pdelta_s,
             ),
             "solve_member": jax.make_jaxpr(
                 lambda *args: _solve_member(

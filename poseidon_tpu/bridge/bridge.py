@@ -122,6 +122,7 @@ from poseidon_tpu.obs.spans import (
     emit_span,
     express_span_tree,
     round_span_tree,
+    stream_span_tree,
 )
 from poseidon_tpu.ops.transport import topology_from_columns
 from poseidon_tpu.trace import TraceGenerator
@@ -304,6 +305,7 @@ class SchedulerBridge:
         topk_prefs: int = 0,
         express_lane: bool = False,
         express_max_batch: int = 16,
+        stream_windows: int = 0,
         shrink_grace_s: float = SHRINK_GRACE_S,
         metrics=None,
         profile_spans: bool = False,
@@ -318,6 +320,7 @@ class SchedulerBridge:
         self.migration_hysteresis = migration_hysteresis
         self.max_migrations_per_round = max_migrations_per_round
         self.express_lane = express_lane
+        self.stream_windows = stream_windows
         self.trace = trace or TraceGenerator()
         # observability: ``metrics`` is an obs.SchedulerMetrics (or
         # None); recording happens ONLY at finish/actuate time from
@@ -373,6 +376,7 @@ class SchedulerBridge:
             topk_prefs=topk_prefs,
             express_lane=express_lane,
             express_max_batch=express_max_batch,
+            stream_windows=stream_windows,
             metrics=metrics,
         )
         # O(churn) graph maintenance: every state transition below is
@@ -448,6 +452,14 @@ class SchedulerBridge:
         self._express_places = 0
         self._express_degrades = 0
         self._express_e2b: list[float] = []
+        # ---- stream-lane bookkeeping (--stream_windows K) ----
+        # per-uid watch receipt stamps of the windows accumulated since
+        # the last flush/finish: each stream placement's e2b is ITS
+        # latency measured at finish time, spanning the whole K-window
+        # accumulation (the sync amortization's honest cost)
+        self._stream_uid_t: dict[str, float] = {}
+        self._stream_t0: float | None = None
+        self._stream_flushes = 0
 
     def _guard_release(self, kind: str, outcome: str, *,
                        gone: int = 0, known: int = 0,
@@ -1080,6 +1092,20 @@ class SchedulerBridge:
                     "express-degrade", label=outcome.reason
                 )
             return None
+        if outcome.degrade_reason:
+            # a CERTIFIED batch that degraded loudly mid-flight (the
+            # change-cap overflow's full placement fetch): every
+            # placement below still binds and the context stays warm —
+            # trace + count the degrade WITHOUT invalidating
+            self._express_degrades += 1
+            self.trace.emit(
+                "EXPRESS_DEGRADE", round_num=self.round_num,
+                detail={"why": outcome.degrade_reason},
+            )
+            if self.metrics is not None:
+                self.metrics.record_express_degrade(
+                    outcome.degrade_reason
+                )
         self._express_batches += 1
         bindings: dict[str, str] = {}
         t_done = time.perf_counter()
@@ -1148,6 +1174,222 @@ class SchedulerBridge:
             rounds=outcome.rounds,
             latency_ms=latency,
             timings=outcome.timings,
+        )
+
+    # ---- the streaming lane (--stream_windows K) -----------------------
+
+    def stream_window(
+        self,
+        pod_events: list[tuple[str, Task]],
+        *,
+        t_event: float | None = None,
+        t_events: list[float] | None = None,
+    ) -> bool:
+        """Accumulate one watch-event window into the pending stream
+        batch (``express_batch``'s head — the SAME observe transitions,
+        coalescing, and degrade gates — but the solve is deferred:
+        ``stream_flush`` scans K accumulated windows as one device
+        program with ONE fetch). Returns True when the window was
+        accumulated (or was pure replay noise); False means the stream
+        degraded and the events wait for the next full round. The
+        events are ALWAYS applied to bridge state either way."""
+        t0 = time.perf_counter()
+        before: dict[str, Task | None] = {}
+        for _typ, pod in pod_events:
+            if pod.uid not in before:
+                before[pod.uid] = self.tasks.get(pod.uid)
+        for typ, pod in pod_events:
+            self.observe_pod_event(typ, pod)
+        if self.lifecycle is not None and t_events is not None:
+            for (_typ, pod), ts in zip(pod_events, t_events):
+                self.lifecycle.backdate_event(pod.uid, ts)
+        if not self.express_lane:
+            return False
+        if not self.solver.express_ready or self._inflight is not None:
+            self.solver.invalidate_express()
+            return False
+        if self._express_unconfirmed:
+            self._express_invalidate(
+                count_degrade=True, why="unconfirmed placements"
+            )
+            return False
+        try:
+            arrivals, removals, slot_deltas = (
+                self._express_transitions(before)
+            )
+        except ValueError as e:
+            self._express_invalidate(count_degrade=True, why=str(e))
+            return False
+        if not (arrivals or removals or slot_deltas
+                or self._express_retire):
+            return True  # pure replay noise: nothing to accumulate
+        try:
+            maps = self.solver.express_maps()
+        except ExpressDegrade as e:
+            self._express_invalidate(count_degrade=True, why=str(e))
+            return False
+        if maps is None:
+            return False
+        midx, rack_idx = maps
+        builder = (
+            self._graph.builder if self._graph is not None
+            else FlowGraphBuilder(preemption=self.enable_preemption)
+        )
+        batch = ExpressBatch(
+            arrivals=[
+                ExpressArrival(
+                    uid=t.uid,
+                    wait_rounds=t.wait_rounds,
+                    cpu_milli=int(t.cpu_request * 1000),
+                    mem_kb=t.memory_request_kb,
+                    prefs=tuple(
+                        builder.task_arc_rows(t, midx, rack_idx)
+                    ),
+                )
+                for t in arrivals
+            ],
+            retires=self._express_retire,
+            removals=removals,
+            slot_deltas=slot_deltas,
+        )
+        self._express_retire = []
+        outcome = self.solver.stream_window(batch)
+        if not outcome.ok:
+            self._express_degrades += 1
+            self.trace.emit(
+                "EXPRESS_DEGRADE", round_num=self.round_num,
+                detail={"why": outcome.reason},
+            )
+            self.trace.flush()
+            if self.metrics is not None:
+                self.metrics.record_express_degrade(outcome.reason)
+            if self.flightrec is not None:
+                self.flightrec.capture_express(
+                    self.round_num, batch, outcome
+                )
+                self.flight_dump(
+                    "express-degrade", label=outcome.reason
+                )
+            return False
+        # receipt stamps for the finish-side per-placement e2b
+        # (earliest wins across coalesced duplicates)
+        if self._stream_t0 is None:
+            self._stream_t0 = t_event if t_event is not None else t0
+        if t_events is not None:
+            for (_typ, pod), ts in zip(pod_events, t_events):
+                self._stream_uid_t.setdefault(pod.uid, ts)
+        elif t_event is not None:
+            for _typ, pod in pod_events:
+                self._stream_uid_t.setdefault(pod.uid, t_event)
+        return True
+
+    def stream_flush(self) -> None:
+        """Dispatch the accumulated windows as one scanned device
+        program (ONE fetch for all of them). Never blocks: the decision
+        log downloads in the background while the NEXT batch's windows
+        accumulate; ``stream_finish`` joins it."""
+        self.solver.stream_flush()
+
+    def stream_finish(self) -> ExpressResult | None:
+        """Join the in-flight stream batch and bind every GOOD
+        window's placements (a mid-stream certificate failure still
+        binds the windows the scan's latch proved before freezing —
+        the degrade is traced and the failed window's events onward
+        wait for the next full round). Returns ``None`` when nothing
+        was in flight or nothing could bind."""
+        out = self.solver.stream_finish()
+        if out is None:
+            return None
+        t_done = time.perf_counter()
+        t0 = self._stream_t0
+        self._stream_t0 = None
+        latency = (t_done - t0) * 1000 if t0 is not None else 0.0
+        bindings: dict[str, str] = {}
+        e2b_samples: list[float] = []
+        window_of: dict[str, int] = {}
+        for uid, machine, wdx in out.placements:
+            task = self.tasks.get(uid)
+            if task is None or task.phase != TaskPhase.PENDING:
+                # the pod left (or bound elsewhere) in a LATER window
+                # of the same stream batch — the deletion was already
+                # applied to bridge state at accumulate time, so the
+                # placement is simply stale, not an invariant breach
+                self._stream_uid_t.pop(uid, None)
+                continue
+            if machine not in self.machines:
+                self._express_invalidate(
+                    count_degrade=True,
+                    why=f"placement target moved for {uid}",
+                )
+                return None
+            bindings[uid] = machine
+            window_of[uid] = wdx
+            self._express_placed[uid] = machine
+            self._express_unconfirmed.add(uid)
+            if self.lifecycle is not None:
+                self.lifecycle.stamp_decided(uid, "stream")
+            self.decision_log.append((
+                self.round_num, "PLACE", uid,
+                {"machine": machine, "express": True,
+                 "stream_window": wdx},
+            ))
+            ts = self._stream_uid_t.pop(uid, None)
+            e2b = (t_done - ts) * 1000 if ts is not None else latency
+            self.trace.emit(
+                "EXPRESS_PLACE", task=uid, machine=machine,
+                round_num=self.round_num,
+                detail={"e2b_ms": round(e2b, 3),
+                        "stream_window": wdx},
+            )
+            self._express_e2b.append(e2b)
+            e2b_samples.append(e2b)
+        good = len(out.window_costs)
+        self._express_batches += good
+        self._express_places += len(bindings)
+        self._stream_flushes += 1
+        self.trace.emit(
+            "STREAM_FLUSH", round_num=self.round_num,
+            detail={
+                "windows": out.windows,
+                "placements": len(bindings),
+                "fetches": out.fetches,
+                "failed_window": out.failed_window,
+            },
+        )
+        if self.profile_spans:
+            emit_span(
+                self.trace,
+                stream_span_tree(
+                    latency, out.timings, windows=out.windows,
+                ),
+                self.round_num,
+            )
+        if not out.ok:
+            # the solver already invalidated the context; the good
+            # windows above are bound, the failed window's events
+            # onward wait for the round path
+            self._express_degrades += 1
+            self.trace.emit(
+                "EXPRESS_DEGRADE", round_num=self.round_num,
+                detail={"why": out.reason},
+            )
+            if self.metrics is not None:
+                self.metrics.record_express_degrade(out.reason)
+            self.flight_dump("express-degrade", label=out.reason)
+        self.trace.flush()
+        if self.metrics is not None:
+            self.metrics.record_express_batch(e2b_samples)
+            self.metrics.record_stream_flush(
+                out.windows, len(bindings)
+            )
+        if not bindings and not out.ok:
+            return None
+        return ExpressResult(
+            bindings=bindings,
+            cost=sum(out.window_costs),
+            rounds=max(out.window_rounds, default=0),
+            latency_ms=latency,
+            timings=out.timings,
         )
 
     def _running_reobserved(
@@ -1521,9 +1763,13 @@ class SchedulerBridge:
         meta = ir.meta
         # a finished round replaces the express context: whatever
         # retire backlog / unconfirmed set the OLD window accumulated
-        # is stale against the new round's rows
+        # is stale against the new round's rows (stream stamps too —
+        # the solver abandoned any pending/in-flight stream batch at
+        # begin_round)
         self._express_retire = []
         self._express_unconfirmed.clear()
+        self._stream_uid_t.clear()
+        self._stream_t0 = None
         # phase accounting: prep+upload feed the price column, the pure
         # device compute is the solve column, the result download the
         # decompose column (transfer vs compute stays distinguishable)
@@ -1828,6 +2074,8 @@ class SchedulerBridge:
         self._express_retire = []
         self._express_unconfirmed.clear()
         self._express_placed.clear()
+        self._stream_uid_t.clear()
+        self._stream_t0 = None
         if self.express_lane:
             self.solver.invalidate_express()
         self._node_shrink_strikes = 0

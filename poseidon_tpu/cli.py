@@ -187,6 +187,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "static kernel shape: ONE compiled variant); "
                         "larger event bursts degrade to the next full "
                         "round")
+    p.add_argument("--stream_windows", type=int, default=0,
+                   help="stream-lane depth K: accumulate up to K "
+                        "express windows and solve them as ONE scanned "
+                        "device program with ONE decision-log fetch "
+                        "(amortizes the ~100ms host-visible sync floor "
+                        "K-ways on linked accelerators; 0/1 = synced "
+                        "per-window dispatch). Requires --express_lane")
     p.add_argument("--express_correction_rounds", type=int, default=1,
                    help="run the full correction round every Nth tick "
                         "while the express context is live (1 = every "
@@ -692,6 +699,7 @@ def run_loop(
         topk_prefs=args.topk_prefs,
         express_lane=args.express_lane == "true",
         express_max_batch=args.express_max_batch,
+        stream_windows=args.stream_windows,
         shrink_grace_s=args.node_grace_s,
         metrics=sched_metrics,
         profile_spans=args.trace_profile == "true",
@@ -816,10 +824,20 @@ def run_loop(
             "(--run_incremental_scheduler=true); every express batch "
             "will degrade to the round path"
         )
+    stream_k = max(args.stream_windows, 0)
+    if stream_k > 1 and not express:
+        log.warning(
+            "--stream_windows needs --express_lane=true (the stream "
+            "lane scans express windows); streaming disabled"
+        )
+        stream_k = 0
+    streaming = stream_k > 1
     # the lane label every round's stats carry (the metrics/report
     # grouping key): the driver is the one place that knows which
     # observe/dispatch composition is actually running
-    lane = "express" if express else (
+    lane = (
+        "stream" if streaming else "express"
+    ) if express else (
         "watch" if watcher is not None else "poll"
     )
     if pipelined:
@@ -1083,6 +1101,75 @@ def run_loop(
                 )
             if ev.needs_tick:
                 return
+
+    def _stream_drain() -> None:
+        """Join the in-flight stream batch and flush+join whatever is
+        still pending, POSTing every binding — the tick path must
+        start with no stream work in flight (begin_round abandons it,
+        and abandoned windows wait a whole round)."""
+        _post_express(bridge.stream_finish())
+        if bridge.solver.stream_pending_windows:
+            bridge.stream_flush()
+            _post_express(bridge.stream_finish())
+
+    def _stream_window(window_s: float) -> None:
+        """The inter-tick stream window (--stream_windows K > 1):
+        accumulate up to K coalesced express windows and solve them as
+        ONE scanned device dispatch with ONE decision-log fetch. Under
+        a backlogged stream consecutive batches pipeline — batch k+1's
+        event uploads stage while batch k's scan is in flight; a dry
+        stream flushes short so placements never sit on accumulated
+        windows until the tick."""
+        deadline = time.monotonic() + window_s
+        while True:
+            if stop.is_set():
+                _stream_drain()
+                return
+            wait = deadline - time.monotonic()
+            if wait <= 0:
+                _stream_drain()
+                return
+            if bridge.solver.stream_inflight:
+                # a scan is in flight: sub-poll so its join (and the
+                # bindings' POSTs) lands within ms of the fetch, not
+                # at the next event's whim
+                wait = min(wait, 0.005)
+            evs = watcher.express_poll_windows(
+                wait, max_events=args.express_max_batch,
+                windows=stream_k,
+                shed_queue=args.express_shed_queue,
+            )
+            for ev in evs:
+                if ev.shed:
+                    log.warning(
+                        "stream window shed to tick: pods stream "
+                        "queue exceeds --express_shed_queue=%d",
+                        args.express_shed_queue,
+                    )
+                    if sched_metrics is not None:
+                        sched_metrics.record_express_shed()
+                if ev.reconnects:
+                    bridge.note_watch_activity(0, ev.reconnects)
+                if ev.pod_events:
+                    bridge.stream_window(
+                        ev.pod_events, t_event=ev.t_first,
+                        t_events=ev.t_events,
+                    )
+                if bridge.solver.stream_pending_windows >= stream_k:
+                    # batch full: join the previous scan (its fetch
+                    # overlapped our uploads), then dispatch this one
+                    _post_express(bridge.stream_finish())
+                    bridge.stream_flush()
+            if evs and (evs[-1].needs_tick or evs[-1].shed):
+                _stream_drain()
+                return
+            if not any(ev.pod_events for ev in evs):
+                # idle poll: join the in-flight batch and flush any
+                # short remainder — the amortization is per-fetch, not
+                # worth holding bindings hostage to a quiet stream
+                _post_express(bridge.stream_finish())
+                if bridge.solver.stream_pending_windows:
+                    bridge.stream_flush()
 
     rounds = 0
     # round-pipeline state: at most one solve in flight across ticks,
@@ -1432,7 +1519,10 @@ def run_loop(
                 # the pods watch stream and bind arrivals immediately.
                 # Declared overload skips the window entirely — the
                 # tick path absorbs the backlog in one solve.
-                _express_window(remaining)
+                if streaming:
+                    _stream_window(remaining)
+                else:
+                    _express_window(remaining)
             else:
                 time.sleep(remaining)
     finally:

@@ -68,7 +68,7 @@ log = logging.getLogger(__name__)
 
 # the bounded lane vocabulary (metric label values); anything else
 # folds to "other"
-LANES = ("tick", "express", "service", "restart", "other")
+LANES = ("tick", "express", "stream", "service", "restart", "other")
 
 # timeline stage names, in lifecycle order
 STAGES = ("event", "decided", "journal", "posted", "confirmed")

@@ -436,7 +436,19 @@ class SchedulerMetrics:
         self.solver_fetches = registry.counter(
             "poseidon_solver_fetches_total",
             "sanctioned device->host placement fetches, by lane "
-            "(round/express)",
+            "(round/express/stream)",
+        )
+        self.stream_flushes = registry.counter(
+            "poseidon_stream_flushes_total",
+            "stream-lane flushes (K accumulated windows scanned as "
+            "one device program with one fetch)",
+        )
+        self.placements_per_fetch = registry.gauge(
+            "poseidon_placements_per_fetch",
+            "placements per sanctioned fetch in the last stream "
+            "flush (the sync-floor amortization the stream lane "
+            "buys; the synced express lane pins this at its "
+            "per-batch placement count)",
         )
         self.solver_warm = registry.gauge(
             "poseidon_solver_warm",
@@ -968,6 +980,19 @@ class SchedulerMetrics:
     def record_express_fetch(self) -> None:
         self.solver_fetches.inc(lane="express")
 
+    # ---- the streaming lane --------------------------------------------
+
+    def record_stream_fetch(self) -> None:
+        self.solver_fetches.inc(lane="stream")
+
+    def record_stream_flush(
+        self, windows: int, placements: int
+    ) -> None:
+        """One stream flush joined: K windows' placements landed on
+        ONE sanctioned fetch (host ints the bridge already holds)."""
+        self.stream_flushes.inc()
+        self.placements_per_fetch.set(placements)
+
     # ---- the service lane ----------------------------------------------
 
     def record_service_round(
@@ -999,6 +1024,8 @@ _WHY_BUCKETS = (
     ("domain", "domain"),
     ("uncertified", "uncertified"),
     ("change cap", "change-cap"),
+    ("change_cap", "change-cap"),
+    ("stream", "stream"),
     ("arrivals >", "batch-size"),
     ("rows exhausted", "rows-exhausted"),
     ("no-context", "no-context"),

@@ -126,6 +126,58 @@ def express_span_tree(latency_ms: float, timings: dict) -> dict:
     }
 
 
+def stream_span_tree(
+    latency_ms: float, timings: dict, *, windows: int = 0
+) -> dict:
+    """One stream flush's span tree (K windows, one fetch): the
+    per-window prep/upload phases on the host track (they overlapped
+    the PREVIOUS batch's scan — that's the double buffer), the stack +
+    scanned solve on the device track, and the single fetch-join last.
+    Perfetto shows the amortization directly: one ``fetch`` interval
+    spanning ``windows`` windows' worth of decisions."""
+    prep = float(timings.get("prep_ms", 0.0))
+    upload = float(timings.get("upload_ms", 0.0))
+    stack = float(timings.get("stack_ms", 0.0))
+    solve = float(timings.get("solve_ms", 0.0))
+    work = prep + upload + stack + solve
+    children = []
+    off = max(latency_ms - work, 0.0)
+    if off:
+        children.append({
+            "name": "accumulate-wait",
+            "off_ms": 0.0,
+            "dur_ms": round(off, 3),
+        })
+    for name, dur in (("prep", prep), ("upload", upload)):
+        children.append({
+            "name": name,
+            "off_ms": round(off, 3),
+            "dur_ms": round(dur, 3),
+        })
+        off += dur
+    children.append({
+        "name": "stack",
+        "track": "device",
+        "off_ms": round(off, 3),
+        "dur_ms": round(stack, 3),
+    })
+    off += stack
+    children.append({
+        "name": "scan+fetch",
+        "track": "device",
+        "off_ms": round(off, 3),
+        "dur_ms": round(solve, 3),
+    })
+    off += solve
+    return {
+        "name": "stream-flush",
+        "lane": "stream",
+        "windows": windows,
+        "dur_ms": round(max(latency_ms, off), 3),
+        "children": children,
+    }
+
+
 def emit_span(trace, tree: dict, round_num: int) -> None:
     """One SPAN trace event per tree (the PTA005-declared type)."""
     trace.emit("SPAN", round_num=round_num, detail=tree)

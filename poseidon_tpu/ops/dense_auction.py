@@ -155,10 +155,27 @@ def max_variants_for(
     return max((DENSE_TABLE_BUDGET_BYTES - base) // per, 0)
 
 
+def max_stream_windows_for(
+    Tp: int, Mp: int, stream_ints: int,
+    side_ints_per_variant: int = 0, extra_ints: int = 0,
+    mesh_width: int = 1,
+) -> int:
+    """Largest ``--stream_windows K`` whose event-stream buffer (plus
+    its double-buffer staging twin: 2 copies of K windows x
+    ``stream_ints`` i32 each) still fits next to one dense [Tp, Mp]
+    table; 0 if even K=1 does not fit."""
+    base = _budget_need(
+        Tp, Mp, 1, side_ints_per_variant, extra_ints, mesh_width
+    )
+    per = 2 * max(stream_ints, 1) * 4
+    return max((DENSE_TABLE_BUDGET_BYTES - base) // per, 0)
+
+
 def check_table_budget(
     Tp: int, Mp: int, n_variants: int = 1,
     side_ints_per_variant: int = 0, extra_ints: int = 0,
-    mesh_width: int = 1,
+    mesh_width: int = 1, stream_windows: int = 0,
+    stream_ints: int = 0,
 ) -> None:
     """Raise DenseMemoryTooLarge if n_variants dense [Tp, Mp] i32
     tables exceed the configured PER-DEVICE HBM budget.
@@ -181,14 +198,32 @@ def check_table_budget(
     (--aggregate_classes / --topk_prefs) that shrink the machine axis
     to its equivalence classes — the escapes the operator can actually
     turn on.
+
+    ``stream_windows`` / ``stream_ints`` account the streaming lane's
+    event buffer: K windows x ``stream_ints`` i32 each, DOUBLED because
+    the next batch's windows stage their uploads while the in-flight
+    scan still holds its stacked buffer (ops/resident.py stream lane).
+    An overflow with streaming on names the largest ``--stream_windows``
+    that would fit.
     """
+    stream_bytes = 2 * max(stream_windows, 0) * max(stream_ints, 0) * 4
     need = _budget_need(
         Tp, Mp, n_variants, side_ints_per_variant, extra_ints,
         mesh_width,
-    )
+    ) + stream_bytes
     if need <= DENSE_TABLE_BUDGET_BYTES:
         return
     batch_hint = ""
+    if stream_windows > 0 and stream_ints > 0:
+        fit_k = max_stream_windows_for(
+            Tp, Mp, stream_ints, side_ints_per_variant, extra_ints,
+            mesh_width,
+        )
+        if fit_k >= 1:
+            batch_hint = (
+                f"the largest stream batch of this shape that fits "
+                f"is --stream_windows={fit_k}; "
+            )
     if n_variants > 1:
         fit_b = max_variants_for(
             Tp, Mp, side_ints_per_variant, extra_ints, mesh_width
@@ -213,10 +248,16 @@ def check_table_budget(
         )
     else:
         mesh_hint = "no practical mesh width fits this shape alone"
+    stream_note = (
+        f", {stream_bytes >> 20} MiB double-buffered stream event "
+        f"buffer ({stream_windows} windows)"
+        if stream_bytes else ""
+    )
     raise DenseMemoryTooLarge(
         f"dense cost table {n_variants} x [{Tp}, {Mp}] i32 "
         f"(+ {side_ints_per_variant} side ints/variant, "
-        f"{extra_ints} scratch ints, mesh width {max(mesh_width, 1)}) "
+        f"{extra_ints} scratch ints, mesh width {max(mesh_width, 1)}"
+        f"{stream_note}) "
         f"= {need >> 20} MiB/device exceeds the "
         f"{DENSE_TABLE_BUDGET_BYTES >> 20} MiB budget "
         f"(POSEIDON_TPU_DENSE_TABLE_BUDGET_MB); {batch_hint}{mesh_hint}; "

@@ -362,14 +362,25 @@ def _express_patch_chunks(rows, cols, deltas):
     return out
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "model_fn", "kmax", "pk", "alpha", "max_rounds", "smax",
-        "change_cap",
-    ),
-)
-def _express_chain(
+def _stream_event_ints(kmax: int, pk: int, pw: int, m_in: int) -> int:
+    """Per-window i32 count of the stream event-stream encoding, for
+    the HBM budget guard: the mini cost inputs (~8 arc-axis arrays over
+    the kmax x (3 + pk) mini arc budget plus task/machine side arrays),
+    the arrival row/pref slices, and the patch triple at width ``pw``.
+    An upper-bound estimate — the guard doubles it for the staging
+    twin, so erring high keeps the degrade loud and early."""
+    e_mini = kmax * (3 + pk)
+    return (
+        e_mini * 8          # mini arc-axis cost-input arrays
+        + kmax * 6          # mini task-axis arrays
+        + m_in * 4          # mini machine-axis arrays
+        + 2 * kmax * pk     # add_pm / add_pr
+        + kmax              # add_row
+        + 3 * pw            # prow / pcol / pdelta
+    )
+
+
+def _express_step(
     dev: DenseInstance,
     dt: DenseTopology,
     cost_dev,
@@ -387,11 +398,17 @@ def _express_chain(
     smax: int,
     change_cap: int,
 ):
-    """ONE fused dispatch turning a small arrival batch into placements:
-    price the arrivals' task-side arcs with the round's cost model,
-    activate their table rows against the warm on-HBM instance, run a
-    bounded eps=1 repair from the existing prices, and compact the
-    changed placements for the one sanctioned fetch.
+    """One express window's device program: price the arrivals'
+    task-side arcs with the round's cost model, activate their table
+    rows against the warm on-HBM instance, run a bounded eps=1 repair
+    from the existing prices, and compact the changed placements for
+    the sanctioned fetch.
+
+    This is the SHARED step body: ``_express_chain`` jits it directly
+    (the synced lane: one window per dispatch per fetch) and
+    ``_stream_chain`` scans it over K pre-uploaded windows (the
+    streaming lane: one fetch per K windows). It must stay a pure
+    function of its arguments so both tracers see the same program.
 
     No rebuild, no cold eps ladder: machine-side routes (``dev.dgen``,
     the m->sink / rack legs gathered from ``cost_dev``) are the LAST
@@ -513,7 +530,169 @@ def _express_chain(
     n_active = jnp.sum(valid2, dtype=I32)
 
     return (dev2, asg_f, lvl_f, floor_f, gap, conv, rounds, phases,
-            rows_out, asg_out, n_changes, domain_ok, primal, n_active)
+            rows_out, asg_out, n_changes, domain_ok, primal, n_active,
+            report)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "model_fn", "kmax", "pk", "alpha", "max_rounds", "smax",
+        "change_cap",
+    ),
+)
+def _express_chain(
+    dev: DenseInstance,
+    dt: DenseTopology,
+    cost_dev,
+    mini_inputs,
+    asg, lvl, floor,
+    add_row,
+    add_pm,
+    add_pr,
+    *,
+    model_fn,
+    kmax: int,
+    pk: int,
+    alpha: int,
+    max_rounds: int,
+    smax: int,
+    change_cap: int,
+):
+    """The synced express lane: ONE fused dispatch of one window
+    (``_express_step``'s program, unchanged). Static args pin one
+    compiled variant per (model, shape bucket, kmax, pk, change_cap)
+    — zero recompiles in steady state. The trailing ``report`` mask
+    rides on device and is only fetched by the change-cap-overflow
+    degrade path (the full sanctioned placement fetch)."""
+    return _express_step(
+        dev, dt, cost_dev, mini_inputs, asg, lvl, floor,
+        add_row, add_pm, add_pr,
+        model_fn=model_fn, kmax=kmax, pk=pk, alpha=alpha,
+        max_rounds=max_rounds, smax=smax, change_cap=change_cap,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "model_fn", "kmax", "pk", "alpha", "max_rounds", "smax",
+        "change_cap",
+    ),
+)
+def _stream_chain(
+    dev: DenseInstance,
+    dt: DenseTopology,
+    cost_dev,
+    mini_stack,    # CostInputs pytree, each leaf stacked [K, ...]
+    asg, lvl, floor,
+    add_row_s,     # i32[K, kmax]
+    add_pm_s,      # i32[K, kmax, pk]
+    add_pr_s,      # i32[K, kmax, pk]
+    prow_s,        # i32[K, pw] retire/removal rows (-1 unused)
+    pcol_s,        # i32[K, pw] slot columns (-1 unused)
+    pdelta_s,      # i32[K, pw] seat deltas
+    *,
+    model_fn,
+    kmax: int,
+    pk: int,
+    alpha: int,
+    max_rounds: int,
+    smax: int,
+    change_cap: int,
+):
+    """The streaming lane: K express windows as ONE ``lax.scan`` over a
+    pre-uploaded event-stream buffer — one dispatch, ONE sanctioned
+    fetch of K compacted per-window decision logs, amortizing this
+    link's flat per-sync charge (PERF.md "The measured link model")
+    across the whole stream batch.
+
+    Each scan step replays exactly what the synced lane does per
+    window: apply the window's retire/removal/slot patch
+    (``_express_patch``'s math), then ``_express_step``'s price →
+    activate → bounded-repair → compact program. Two stream-only
+    pieces keep the K-window composition equivalent to K synced
+    dispatches:
+
+    - **auto-retire**: the synced lane retires each window's reported
+      placements via the NEXT window's patch list (bindings confirm
+      between fetches). Mid-stream there is no host in the loop, so
+      the step retires its own report in-device — deactivate the row,
+      consume the seat at the winning column — before handing the
+      carry to the next window. Bit-identical to the synced sequence
+      with every binding confirmed between windows (the steady state;
+      the host-side twin drops the later confirm-driven retire).
+    - **per-window certificate latching**: ``live`` starts True and
+      latches False on the first window whose certificate fails
+      (uncertified repair, cost-domain overflow, or a changed-row
+      count past the compaction cap). A dead window's carry freezes at
+      the last good state and its outputs are masked, so the host sees
+      exactly which window failed and replays from there via the
+      synced/round path — never a silent partial commit.
+
+    Static args + the [K, ...] buffer shapes (grow-only floors on K's
+    padding and the patch width) pin one compiled variant — zero
+    recompiles in steady state, including draining flushes (short
+    batches pad with no-op windows of the same shape).
+    """
+    Tp, Mp = dev.c.shape
+
+    def step(carry, xs):
+        c, u, w, s, valid, asg_c, lvl_c, floor_c, live = carry
+        mini, add_row, add_pm, add_pr, prow, pcol, pdelta = xs
+        u1, w1, valid1, s1, asg1, lvl1 = _express_patch(
+            u, w, valid, s, asg_c, lvl_c, prow, pcol, pdelta
+        )
+        dev_w = DenseInstance(
+            c=c, u=u1, w=w1, dgen=dev.dgen, s=s1, task_valid=valid1,
+            scale=dev.scale, cmax=dev.cmax, smax=smax,
+        )
+        (dev2, asg_f, lvl_f, floor_f, _gap, conv, rounds, _phases,
+         rows_out, asg_out, n_changes, domain_ok, primal, _n_active,
+         report) = _express_step(
+            dev_w, dt, cost_dev, mini, asg1, lvl1, floor_c,
+            add_row, add_pm, add_pr,
+            model_fn=model_fn, kmax=kmax, pk=pk, alpha=alpha,
+            max_rounds=max_rounds, smax=smax, change_cap=change_cap,
+        )
+        win_ok = conv & domain_ok & (n_changes <= jnp.int32(change_cap))
+        live2 = live & win_ok
+        # auto-retire the window's reported placements (the synced
+        # lane's next-batch retire patch, applied in-device): row
+        # deactivates, seat consumed at the winning column
+        valid_r = dev2.task_valid & ~report
+        u_r = jnp.where(report, 0, dev2.u)
+        w_r = jnp.where(report, INF, dev2.w)
+        s_r = dev2.s.at[
+            jnp.where(report, jnp.clip(asg_f, 0, Mp - 1), Mp)
+        ].add(-1, mode="drop")
+        s_r = jnp.maximum(s_r, 0)
+        asg_r = jnp.where(report, Mp, asg_f)
+        lvl_r = jnp.where(report, 0, lvl_f)
+
+        def sel(new, old):
+            return jnp.where(live2, new, old)
+
+        carry2 = (
+            sel(dev2.c, c), sel(u_r, u), sel(w_r, w), sel(s_r, s),
+            jnp.where(live2, valid_r, valid), sel(asg_r, asg_c),
+            sel(lvl_r, lvl_c), sel(floor_f, floor_c), live2,
+        )
+        ys = (
+            jnp.where(live2, rows_out, Tp),
+            jnp.where(live2, asg_out, -1),
+            n_changes, live2, conv, domain_ok, rounds,
+            jnp.where(live2, primal, jnp.int64(0)),
+        )
+        return carry2, ys
+
+    carry0 = (
+        dev.c, dev.u, dev.w, dev.s, dev.task_valid, asg, lvl, floor,
+        jnp.asarray(True),
+    )
+    xs = (mini_stack, add_row_s, add_pm_s, add_pr_s,
+          prow_s, pcol_s, pdelta_s)
+    return jax.lax.scan(step, carry0, xs)
 
 
 _MODEL_JIT_CACHE: dict[object, object] = {}
@@ -763,6 +942,10 @@ class ExpressOutcome:
     cost: int = 0
     rounds: int = 0
     reason: str = ""
+    # ok=True but something degraded LOUDLY along the way (change-cap
+    # overflow's full placement fetch): the bridge traces/counts an
+    # EXPRESS_DEGRADE with this reason while still binding everything
+    degrade_reason: str = ""
     timings: dict = dataclasses.field(default_factory=dict)
 
 
@@ -816,12 +999,73 @@ class _ExpressContext:
     members_per_col: np.ndarray | None = None
     member_slots_left: np.ndarray | None = None
     batches: int = 0
+    # uids the streaming lane already retired IN-DEVICE (the scan's
+    # auto-retire): the later confirm-driven retire for the same uid
+    # must not double-apply its seat decrement
+    stream_retired: set = dataclasses.field(default_factory=set)
 
 
 # chunk width for the retire/slot patch kernel: backlogs larger than
 # one chunk (a big round's bindings, a preemption-mode freeze of every
 # running row) apply as several cheap scatter dispatches
 _EXPRESS_PATCH_CHUNK = 1024
+
+
+@dataclasses.dataclass
+class StreamOutcome:
+    """One stream flush's result (K windows, one sanctioned fetch).
+
+    ``ok=False`` with ``failed_window >= 0`` means a mid-stream window
+    failed its certificate: ``placements`` still carries every GOOD
+    window's bindings (windows before ``failed_window`` — the scan's
+    latch froze the carry there, so they are exactly what a synced
+    replay would have produced), the context is invalidated, and the
+    failed window's events onward wait for the next full round."""
+
+    ok: bool
+    placements: list[tuple[str, str, int]] = dataclasses.field(
+        default_factory=list)     # (uid, machine name, window idx)
+    window_costs: list[int] = dataclasses.field(default_factory=list)
+    window_rounds: list[int] = dataclasses.field(default_factory=list)
+    windows: int = 0              # real (non-padding) windows flushed
+    failed_window: int = -1
+    reason: str = ""
+    fetches: int = 0              # sanctioned fetches this flush (1)
+    timings: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class _StreamWindow:
+    """One accumulated-but-not-flushed stream window: the host event
+    encoding plus its staged device twin (uploaded at accumulate time,
+    so batch k+1's uploads overlap batch k's in-flight scan — the
+    double buffer)."""
+
+    host: tuple                   # (mini, add_row, add_pm, add_pr,
+                                  #  prow, pcol, pdelta)
+    dev: tuple                    # staged device twin of ``host``
+    pw: int                       # patch width the staging padded to
+    journal: list                 # [(row, old_uid|None, new_uid|None)]
+    prep_ms: float = 0.0
+    upload_ms: float = 0.0
+
+
+@dataclasses.dataclass
+class _InflightStream:
+    """A dispatched-but-not-fetched stream batch. The background
+    download of the K compacted decision logs runs from dispatch time;
+    the next batch's windows accumulate (and stage their uploads)
+    while this one is in flight."""
+
+    future: object                # _AsyncFetch of the K-window log
+    carry: tuple                  # final device carry (c,u,w,s,valid,
+                                  #  asg,lvl,floor,live)
+    ctx: object                   # the _ExpressContext it solved under
+    n_windows: int                # real windows (rest are no-op pads)
+    journals: list                # per real window row-map journals
+    row_uid_end: dict             # ctx.row_uid snapshot at flush time
+    timings: dict = dataclasses.field(default_factory=dict)
+    t_dispatch: float = 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -940,6 +1184,7 @@ class ResidentSolver:
         express_lane: bool = False,
         express_max_batch: int = 16,
         express_change_cap: int = 256,
+        stream_windows: int = 0,
         metrics=None,
     ):
         self.alpha = alpha
@@ -1012,6 +1257,29 @@ class ResidentSolver:
         self._express: _ExpressContext | None = None
         # lifetime sanctioned express fetches (one per express batch)
         self.express_fetches = 0
+        # ---- the streaming lane (K express windows per fetch) ----
+        # stream_windows K > 1 accumulates K express windows and solves
+        # them as ONE lax.scan dispatch with ONE sanctioned fetch of K
+        # compacted decision logs (_stream_chain) — the link's flat
+        # per-sync charge amortizes K-ways. 0/1 = off (synced express).
+        self.stream_windows = stream_windows
+        # grow-only per-window patch-width bucket (anti-recompile
+        # hysteresis for the retire/removal/slot slice of the event-
+        # stream buffer; kmax/pk already pin the arrival slice)
+        self._stream_pw_floor = 16
+        self._stream_pending: list[_StreamWindow] = []
+        self._stream_inflight: _InflightStream | None = None
+        # observability: lifetime sanctioned stream fetches (one per
+        # flush), the window count the LAST flush amortized, and the
+        # stream twin of last_round_fetches (exactly 1 on the
+        # certified stream path — asserted by tests/test_stream.py)
+        self.stream_fetches = 0
+        self.last_stream_windows = 0
+        self.last_stream_fetches = 0
+        # defensive: flushed-but-unjoined stream batches a full round
+        # had to abandon (the cli drains streams before every tick, so
+        # nonzero means a driver bug worth surfacing)
+        self.stream_abandoned = 0
         # host mirror of the warm state (asg/lvl/floor from the round's
         # own batched fetch) + whether an express batch has since
         # mutated the on-HBM warm state without a full-state fetch —
@@ -1024,6 +1292,8 @@ class ResidentSolver:
         self._express = None
         self._warm_seed = None
         self._warm_mutated = True
+        self._stream_pending = []
+        self._stream_inflight = None
 
     @property
     def warm_seed_host(self) -> tuple | None:
@@ -1111,8 +1381,35 @@ class ResidentSolver:
     def invalidate_express(self) -> None:
         """Drop the express context: the next batches wait for a full
         round. Called by the bridge whenever cluster state moves in a
-        way the on-HBM patch vocabulary cannot represent."""
+        way the on-HBM patch vocabulary cannot represent. Pending
+        (unflushed) stream windows reference the context, so they drop
+        with it — their events are already in bridge state and wait
+        for the round like any degraded batch."""
         self._express = None
+        self._stream_pending = []
+
+    # ---- the streaming lane (K windows per sanctioned fetch) ----------
+
+    @property
+    def stream_pending_windows(self) -> int:
+        """Accumulated-but-not-flushed stream windows."""
+        return len(self._stream_pending)
+
+    @property
+    def stream_inflight(self) -> bool:
+        """True while a flushed stream batch's fetch is in flight."""
+        return self._stream_inflight is not None
+
+    def _stream_abandon(self) -> None:
+        """Defensive round-boundary cleanup: drop pending windows and
+        abandon any in-flight stream fetch (its daemon thread finishes
+        harmlessly; the round replaces all device state). The cli
+        drains streams before every tick, so a nonzero abandon count
+        flags a driver bug — counted, never silent."""
+        self._stream_pending = []
+        if self._stream_inflight is not None:
+            self._stream_inflight = None
+            self.stream_abandoned += 1
 
     @property
     def warm(self) -> DenseState | None:
@@ -1176,7 +1473,10 @@ class ResidentSolver:
         # the context FIRST so its HBM (the retained dense table) is
         # free before this round's chain allocates a fresh one
         self._express = None
+        self._stream_abandon()
         self.last_round_fetches = 0
+        self.last_stream_windows = 0
+        self.last_stream_fetches = 0
         timings: dict[str, float] = {}
         t0 = time.perf_counter()
         # grow-only bucket floors: arc/task counts oscillating across a
@@ -1289,8 +1589,18 @@ class ResidentSolver:
         Tp = dt_host.arc_unsched.shape[0]
         Mp = dt_host.slots.shape[0]
         try:
+            stream_k = (
+                self.stream_windows
+                if self.express_lane and self.stream_windows > 0
+                else 0
+            )
             check_table_budget(
-                Tp, Mp, mesh_width=max(self.mesh_width, 1)
+                Tp, Mp, mesh_width=max(self.mesh_width, 1),
+                stream_windows=stream_k,
+                stream_ints=_stream_event_ints(
+                    self.express_max_batch, P,
+                    self._stream_pw_floor, self._mi_floor,
+                ) if stream_k else 0,
             )
         except DenseMemoryTooLarge as e:
             # degrade loudly BEFORE any device allocation: the guard,
@@ -1996,7 +2306,7 @@ class ResidentSolver:
                 with enable_x64(True):
                     (dev2, asg_f, lvl_f, floor_f, gap, conv, rounds_d,
                      phases, rows_out, asg_out, n_changes, domain_ok,
-                     primal, n_active) = _express_chain(
+                     primal, n_active, report) = _express_chain(
                         dev, ctx.dt, ctx.cost_dev, mini_dev,
                         asg, lvl, floor,
                         add_row_d, add_pm_d, add_pr_d,
@@ -2023,11 +2333,31 @@ class ResidentSolver:
                 raise ExpressDegrade(
                     f"repair uncertified after {int(rnds_np)} rounds"
                 )
+            degrade_reason = ""
             if int(n_chg) > self.express_change_cap:
-                raise ExpressDegrade(
-                    f"{int(n_chg)} changed placements > change cap "
-                    f"{self.express_change_cap}"
+                # the repair is CERTIFIED — only the compacted log is
+                # truncated. Killing the batch here (the old behavior)
+                # threw away a proven optimum after its fetch already
+                # happened; instead degrade LOUDLY to a full sanctioned
+                # placement fetch: one extra download of the changed-
+                # row mask + assignment, every placement still binds,
+                # and the bridge traces EXPRESS_DEGRADE(change_cap)
+                # with the context kept warm.
+                degrade_reason = (
+                    f"change_cap: {int(n_chg)} changed placements > "
+                    f"cap {self.express_change_cap} (full placement "
+                    f"fetch)"
                 )
+                self.express_fetches += 1
+                if self.metrics is not None:
+                    self.metrics.record_express_fetch()
+                with sanctioned_transfer():
+                    rep_np, asg_full = jax.device_get(  # noqa: PTA001 -- the change-cap degrade's one extra sanctioned fetch: full changed-row mask + assignment (certified state, loudly counted)
+                        (report, asg_f)
+                    )
+                rows_np = np.flatnonzero(rep_np).astype(np.int32)
+                asg_np = np.asarray(asg_full)[rows_np]  # noqa: PTA001 -- already-fetched host data
+                n_chg = len(rows_np)
             # ---- commit: the patched instance + repaired state ARE
             # the warm state the next round/batch starts from ----
             ctx.dev = dev2
@@ -2057,12 +2387,490 @@ class ResidentSolver:
                 placements=placements,
                 cost=int(primal_np) // ctx.scale,
                 rounds=int(rnds_np),
+                degrade_reason=degrade_reason,
                 timings=timings,
             )
         except ExpressDegrade as e:
             self._express = None
             return ExpressOutcome(ok=False, reason=str(e),
                                   timings=timings)
+
+    # ---- the streaming lane: accumulate / flush / finish --------------
+
+    def _stream_apply_freeze(self, ctx: _ExpressContext, warm) -> None:
+        """Rebalancing mode's first stream window: the running block's
+        freeze is cluster-sized, so apply it eagerly as the synced
+        lane's chunked patch dispatches (async, no fetch) instead of
+        widening every window's fixed patch slice to cluster size.
+        Composition order matches the synced lane exactly: freeze
+        patches land before window 0's own patch + repair."""
+        fr, fc = ctx.pending_freeze
+        ctx.pending_freeze = None
+        if not len(fr):
+            return
+        with no_implicit_transfers():
+            chunks = self._express_put(_express_patch_chunks(
+                fr.tolist(), fc.tolist(), [-1] * len(fr)
+            ))
+            u_d, w_d, valid_d, s_d = (
+                ctx.dev.u, ctx.dev.w, ctx.dev.task_valid, ctx.dev.s
+            )
+            asg, lvl = warm.asg, warm.lvl
+            for rows_d, cols_d, deltas_d in chunks:
+                u_d, w_d, valid_d, s_d, asg, lvl = _express_patch(
+                    u_d, w_d, valid_d, s_d, asg, lvl,
+                    rows_d, cols_d, deltas_d,
+                )
+        ctx.dev = DenseInstance(
+            c=ctx.dev.c, u=u_d, w=w_d, dgen=ctx.dev.dgen, s=s_d,
+            task_valid=valid_d, scale=ctx.dev.scale, cmax=ctx.dev.cmax,
+            smax=ctx.dev.smax,
+        )
+        self._warm = DenseState(
+            asg=asg, lvl=lvl, floor=warm.floor, gap=warm.gap,
+            converged=warm.converged, rounds=warm.rounds,
+            phases=warm.phases,
+        )
+        self._warm_mutated = True
+
+    def stream_window(self, batch: ExpressBatch) -> ExpressOutcome:
+        """Accumulate one coalesced watch-event window into the pending
+        stream batch WITHOUT solving it: encode the window into the
+        fixed-shape per-window slices ``_stream_chain`` scans (arrival
+        rows at kmax x pk, patches padded to the grow-only patch-width
+        bucket) and stage its device upload NOW — while the previous
+        batch's scan is in flight the upload overlaps it (the double
+        buffer). No placements come back until ``stream_flush`` +
+        ``stream_finish``; ``ok=True`` means "accumulated".
+
+        Host maps (uid<->row, free rows, member seats) advance at
+        accumulate time exactly as the synced lane's, with every
+        mutation journaled so the finish-side row resolution can roll
+        the map back to each window's in-scan view. Degrades exactly
+        like ``express_round`` for anything the patch vocabulary
+        cannot represent (ok=False; context + pending windows dropped;
+        the events wait for the next full round)."""
+        ctx = self._express
+        if ctx is None:
+            return ExpressOutcome(ok=False, reason="no-context")
+        if self._inflight:
+            return ExpressOutcome(ok=False, reason="round-in-flight")
+        if len(self._stream_pending) >= max(self.stream_windows, 1):
+            # driver contract: flush at K windows; refuse loudly
+            # rather than silently grow past the compiled scan length
+            self._express = None
+            self._stream_pending = []
+            return ExpressOutcome(
+                ok=False, reason="stream buffer full (flush first)"
+            )
+        timings: dict[str, float] = {}
+        t0 = time.perf_counter()
+        journal: list[tuple[int, str | None, str | None]] = []
+        try:
+            self._express_finalize(ctx)
+            kmax = self.express_max_batch
+            pk = ctx.n_prefs
+            arrivals = batch.arrivals
+            if len(arrivals) > kmax:
+                raise ExpressDegrade(
+                    f"{len(arrivals)} arrivals > --express_max_batch "
+                    f"{kmax}"
+                )
+            warm = self._warm
+            if warm is None:
+                raise ExpressDegrade("no warm state")
+            if ctx.pending_freeze is not None:
+                self._stream_apply_freeze(ctx, warm)
+                warm = self._warm
+            # ---- map retires / removals / slot deltas to patches ----
+            rows: list[int] = []
+            cols: list[int] = []
+            deltas: list[int] = []
+            for uid, mname in batch.retires:
+                if uid in ctx.stream_retired:
+                    # the scan already retired this row in-device at
+                    # placement time (auto-retire): the confirm-driven
+                    # twin must not double-apply the seat decrement
+                    ctx.stream_retired.discard(uid)
+                    continue
+                r = ctx.uid_row.pop(uid, None)
+                if r is None:
+                    raise ExpressDegrade(f"retire of unknown {uid}")
+                ctx.row_uid.pop(r, None)
+                ctx.free_rows.append(r)
+                journal.append((r, uid, None))
+                m = ctx.midx.get(mname)
+                if m is None:
+                    raise ExpressDegrade(
+                        f"retire on unknown machine {mname}"
+                    )
+                rows.append(r)
+                cols.append(self._express_col(ctx, m))
+                deltas.append(-1)
+            for uid in batch.removals:
+                r = ctx.uid_row.pop(uid, None)
+                if r is None:
+                    raise ExpressDegrade(f"removal of unknown {uid}")
+                ctx.row_uid.pop(r, None)
+                ctx.free_rows.append(r)
+                journal.append((r, uid, None))
+                rows.append(r)
+                cols.append(-1)
+                deltas.append(0)
+            for mname, d in batch.slot_deltas:
+                m = ctx.midx.get(mname)
+                if m is None:
+                    raise ExpressDegrade(
+                        f"slot delta on unknown machine {mname}"
+                    )
+                rows.append(-1)
+                cols.append(self._express_col(ctx, m))
+                deltas.append(d)
+                if ctx.member_slots_left is not None:
+                    ctx.member_slots_left[m] = max(
+                        ctx.member_slots_left[m] + d, 0
+                    )
+            # ---- map arrivals to rows + solve-space pref targets ----
+            add_row = np.full(kmax, -1, np.int32)
+            add_pm = np.full((kmax, pk), -1, np.int32)
+            add_pr = np.full((kmax, pk), -1, np.int32)
+            for k, a in enumerate(arrivals):
+                if a.uid in ctx.uid_row:
+                    raise ExpressDegrade(f"duplicate arrival {a.uid}")
+                if len(a.prefs) > pk:
+                    raise ExpressDegrade(
+                        f"{a.uid} has {len(a.prefs)} prefs > the "
+                        f"round's pref width {pk}"
+                    )
+                if not ctx.free_rows:
+                    raise ExpressDegrade(
+                        "padded task rows exhausted (cluster outgrew "
+                        "the round's bucket)"
+                    )
+                r = ctx.free_rows.pop()
+                ctx.uid_row[a.uid] = r
+                ctx.row_uid[r] = a.uid
+                journal.append((r, None, a.uid))
+                add_row[k] = r
+                for j, (m, rk, _w) in enumerate(a.prefs):
+                    if m >= 0:
+                        col = self._express_col(ctx, m)
+                        if (ctx.members_per_col is not None
+                                and ctx.members_per_col[col] != 1):
+                            raise ExpressDegrade(
+                                f"{a.uid} prefers machine {m} inside "
+                                f"a non-singleton class (not pinned "
+                                f"at the last round)"
+                            )
+                        add_pm[k, j] = col
+                    else:
+                        add_pr[k, j] = rk
+            mini_host = self._express_mini_inputs(
+                ctx, arrivals, kmax, pk
+            )
+            # fixed-width patch slice under a grow-only bucket floor:
+            # a window with a bigger backlog grows the floor (one
+            # recompile); steady state never recompiles
+            pw = pad_bucket(
+                max(len(rows), 1), minimum=self._stream_pw_floor
+            )
+            if pw > self._stream_pw_floor:
+                self._stream_pw_floor = pw
+            prow = np.full(pw, -1, np.int32)
+            pcol = np.full(pw, -1, np.int32)
+            pdelta = np.zeros(pw, np.int32)
+            n = len(rows)
+            prow[:n] = rows
+            pcol[:n] = cols
+            pdelta[:n] = deltas
+            timings["prep_ms"] = (time.perf_counter() - t0) * 1000
+            host = (mini_host, add_row, add_pm, add_pr,
+                    prow, pcol, pdelta)
+            t0u = time.perf_counter()
+            with no_implicit_transfers():
+                devt = self._express_put(host)
+            timings["upload_ms"] = (time.perf_counter() - t0u) * 1000
+            self._stream_pending.append(_StreamWindow(
+                host=host, dev=devt, pw=pw, journal=journal,
+                prep_ms=timings["prep_ms"],
+                upload_ms=timings["upload_ms"],
+            ))
+            return ExpressOutcome(ok=True, timings=timings)
+        except ExpressDegrade as e:
+            self._express = None
+            self._stream_pending = []
+            return ExpressOutcome(ok=False, reason=str(e),
+                                  timings=timings)
+
+    def stream_flush(self) -> None:
+        """Dispatch the accumulated windows as ONE ``_stream_chain``
+        scan and start the ONE background fetch of the K compacted
+        decision logs. No-op when nothing is pending or a batch is
+        already in flight (``stream_finish`` first — the certificate
+        join serializes scans). Never joins: between flush and finish
+        the next batch's windows accumulate and stage their uploads
+        against the in-flight scan."""
+        if not self._stream_pending:
+            return
+        if self._stream_inflight is not None:
+            return
+        ctx = self._express
+        warm = self._warm
+        if ctx is None or warm is None:
+            self._stream_pending = []
+            return
+        windows = list(self._stream_pending)
+        self._stream_pending = []
+        K = max(self.stream_windows, 1)
+        real = len(windows)
+        timings = {
+            "prep_ms": sum(w.prep_ms for w in windows),
+            "upload_ms": sum(w.upload_ms for w in windows),
+        }
+        kmax = self.express_max_batch
+        pk = ctx.n_prefs
+        pw = self._stream_pw_floor
+        t0 = time.perf_counter()
+        with no_implicit_transfers():
+            for wdw in windows:
+                if wdw.pw != pw:
+                    # the patch-width floor grew mid-batch: re-pad +
+                    # re-stage the earlier windows (once per floor
+                    # growth; zero in steady state)
+                    mini, a_r, a_pm, a_pr, pr0, pc0, pd0 = wdw.host
+                    pr1 = np.full(pw, -1, np.int32)
+                    pc1 = np.full(pw, -1, np.int32)
+                    pd1 = np.zeros(pw, np.int32)
+                    pr1[:len(pr0)] = pr0
+                    pc1[:len(pc0)] = pc0
+                    pd1[:len(pd0)] = pd0
+                    wdw.host = (mini, a_r, a_pm, a_pr, pr1, pc1, pd1)
+                    wdw.dev = self._express_put(wdw.host)
+                    wdw.pw = pw
+            if real < K:
+                # draining flush: pad to the compiled scan length with
+                # no-op windows (no arrivals, no patches) — the same
+                # shapes, so the same compiled program
+                noop_host = (
+                    self._express_mini_inputs(ctx, [], kmax, pk),
+                    np.full(kmax, -1, np.int32),
+                    np.full((kmax, pk), -1, np.int32),
+                    np.full((kmax, pk), -1, np.int32),
+                    np.full(pw, -1, np.int32),
+                    np.full(pw, -1, np.int32),
+                    np.zeros(pw, np.int32),
+                )
+                noop = _StreamWindow(
+                    host=noop_host, dev=self._express_put(noop_host),
+                    pw=pw, journal=[],
+                )
+                windows = windows + [noop] * (K - real)
+            # stack the staged per-window device slices into the
+            # [K, ...] event-stream buffer (pure device reshuffle:
+            # async dispatches, no host sync)
+            stacked = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls), *[w.dev for w in windows]
+            )
+            (mini_s, add_row_s, add_pm_s, add_pr_s,
+             prow_s, pcol_s, pdelta_s) = stacked
+            timings["stack_ms"] = (time.perf_counter() - t0) * 1000
+            t_dispatch = time.perf_counter()
+            with enable_x64(True):
+                carry, ys = _stream_chain(
+                    ctx.dev, ctx.dt, ctx.cost_dev, mini_s,
+                    warm.asg, warm.lvl, warm.floor,
+                    add_row_s, add_pm_s, add_pr_s,
+                    prow_s, pcol_s, pdelta_s,
+                    model_fn=ctx.model_fn, kmax=kmax, pk=pk,
+                    alpha=self.alpha, max_rounds=EXPRESS_FUSE,
+                    smax=ctx.smax,
+                    change_cap=self.express_change_cap,
+                )
+        self.stream_fetches += 1
+        self.last_stream_fetches = 1
+        self.last_stream_windows = real
+        if self.metrics is not None:
+            self.metrics.record_stream_fetch()
+
+        def _fetch():
+            with sanctioned_transfer():
+                return jax.device_get(ys)  # noqa: PTA001 -- the stream batch's ONE sanctioned fetch: K compacted decision logs + certificate bits
+
+        self._stream_inflight = _InflightStream(
+            future=_AsyncFetch(_fetch),
+            carry=carry, ctx=ctx, n_windows=real,
+            journals=[w.journal for w in windows[:real]],
+            # ONE snapshot per K-window flush, amortized across the
+            # whole stream batch (finish's row resolution rolls it
+            # back through the per-window journals)
+            row_uid_end=dict(ctx.row_uid),
+            timings=timings, t_dispatch=t_dispatch,
+        )
+
+    def stream_finish(self) -> StreamOutcome | None:
+        """Join the in-flight stream batch: the ONE fetch carrying K
+        windows' compacted decision logs + certificate bits. Commits
+        the scan's final carry as the warm on-HBM state (the latch
+        guarantees it is the last GOOD window's state even when a
+        later window failed), resolves each window's compacted rows to
+        uids through the journal rollback, and expands aggregation
+        columns to members exactly as the synced lane does. Returns
+        None when nothing is in flight; never raises."""
+        inf = self._stream_inflight
+        if inf is None:
+            return None
+        self._stream_inflight = None
+        ctx = inf.ctx
+        real = inf.n_windows
+        try:
+            fetched = inf.future.result(self._fetch_deadline_s())
+        except FetchTimeout:
+            self.fetch_timeouts += 1
+            # the device link is suspect: drop everything warm (the
+            # same abandon the round path makes) — never a silent wait
+            self._express = None
+            self._stream_pending = []
+            self._warm = None
+            self._warm_mutated = True
+            return StreamOutcome(
+                ok=False, reason="stream fetch deadline missed",
+                windows=real, fetches=1, timings=inf.timings,
+            )
+        (rows_np, asg_np, nchg_np, live_np, conv_np, dom_np, rnds_np,
+         primal_np) = fetched
+        timings = dict(inf.timings)
+        timings["solve_ms"] = (
+            time.perf_counter() - inf.t_dispatch
+        ) * 1000
+        if self._express is not ctx:
+            # a degrade invalidated the context between flush and
+            # finish: nothing to commit against, and the events are
+            # already waiting for the round path
+            return StreamOutcome(
+                ok=False, reason="context invalidated mid-flight",
+                windows=real, fetches=1, timings=timings,
+            )
+        # ---- first failed window (if any) + its reason ----
+        failed = -1
+        reason = ""
+        for wdx in range(real):
+            if bool(live_np[wdx]):
+                continue
+            failed = wdx
+            if not bool(dom_np[wdx]):
+                reason = f"window {wdx}: cost domain exceeded"
+            elif not bool(conv_np[wdx]):
+                reason = (
+                    f"window {wdx}: repair uncertified after "
+                    f"{int(rnds_np[wdx])} rounds"
+                )
+            elif int(nchg_np[wdx]) > self.express_change_cap:
+                reason = (
+                    f"window {wdx}: change_cap: {int(nchg_np[wdx])} "
+                    f"changed placements > cap "
+                    f"{self.express_change_cap}"
+                )
+            else:
+                reason = f"window {wdx}: certificate failed"
+            break
+        good = real if failed < 0 else failed
+        # ---- commit the final carry as the warm on-HBM state (the
+        # last good window's state: valid even mid-stream-failure) ----
+        (c_d, u_d, w_d, s_d, valid_d, asg_d, lvl_d, floor_d,
+         _live) = inf.carry
+        ctx.dev = DenseInstance(
+            c=c_d, u=u_d, w=w_d, dgen=ctx.dev.dgen, s=s_d,
+            task_valid=valid_d, scale=ctx.dev.scale, cmax=ctx.dev.cmax,
+            smax=ctx.dev.smax,
+        )
+        ctx.batches += good
+        self._warm = DenseState(
+            asg=asg_d, lvl=lvl_d, floor=floor_d,
+            gap=jnp.int32(0), converged=jnp.asarray(True),
+            rounds=jnp.int32(0), phases=jnp.int32(0),
+        )
+        self._warm_mutated = True
+        # ---- resolve per-window compacted rows to uids: roll the
+        # row<->uid map back through the journals, last window first
+        # (each window resolves against the exact map state its scan
+        # step saw) ----
+        Tp = ctx.Tp
+        cap = self.express_change_cap
+        by_win: dict[int, list[tuple[str, int]]] = {}
+        cur = inf.row_uid_end
+        bad = ""
+        for wdx in range(real - 1, -1, -1):
+            if wdx < good and not bad:
+                out: list[tuple[str, int]] = []
+                for i in range(min(int(nchg_np[wdx]), cap)):
+                    r = int(rows_np[wdx, i])
+                    if r >= Tp:
+                        break
+                    uid = cur.get(r)
+                    if uid is None:
+                        bad = (
+                            f"window {wdx}: placement on unmapped "
+                            f"row {r}"
+                        )
+                        break
+                    out.append((uid, int(asg_np[wdx, i])))
+                by_win[wdx] = out
+            for row, old, _new in reversed(inf.journals[wdx]):
+                if old is None:
+                    cur.pop(row, None)
+                else:
+                    cur[row] = old
+        if bad:
+            self._express = None
+            self._stream_pending = []
+            return StreamOutcome(
+                ok=False, reason=bad, windows=real, fetches=1,
+                timings=timings,
+            )
+        # ---- expand columns to members in forward window order (the
+        # synced lane's per-batch report order, so seat accounting
+        # matches bit-for-bit) ----
+        placements: list[tuple[str, str, int]] = []
+        try:
+            for wdx in range(good):
+                for uid, col in by_win.get(wdx, ()):
+                    placements.append(
+                        (uid, self._express_member(ctx, col), wdx)
+                    )
+        except ExpressDegrade as e:
+            self._express = None
+            self._stream_pending = []
+            return StreamOutcome(
+                ok=False, reason=str(e), windows=real, fetches=1,
+                timings=timings,
+            )
+        # host twin of the scan's auto-retire: free the placed rows
+        # and mark the uids so the confirm-driven retire is a no-op
+        for uid, _m, _w in placements:
+            r = ctx.uid_row.pop(uid, None)
+            if r is not None:
+                ctx.row_uid.pop(r, None)
+                ctx.free_rows.append(r)
+            ctx.stream_retired.add(uid)
+        window_costs = [
+            int(primal_np[w]) // ctx.scale for w in range(good)
+        ]
+        window_rounds = [int(rnds_np[w]) for w in range(good)]
+        if failed >= 0:
+            self._express = None
+            self._stream_pending = []
+            return StreamOutcome(
+                ok=False, placements=placements,
+                window_costs=window_costs,
+                window_rounds=window_rounds, windows=real,
+                failed_window=failed, reason=reason, fetches=1,
+                timings=timings,
+            )
+        return StreamOutcome(
+            ok=True, placements=placements,
+            window_costs=window_costs, window_rounds=window_rounds,
+            windows=real, fetches=1, timings=timings,
+        )
 
     # margin on the oracle degrade path needs the full [T, M] route
     # table on host; above this many cells it is skipped (cost still

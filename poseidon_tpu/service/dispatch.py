@@ -488,6 +488,7 @@ class BatchDispatcher:
         n_chunks = 0
         counter = CompileCounter()
         with counter:
+            waves: list[tuple[tuple, list]] = []
             for key, members in sorted(buckets.items()):
                 Tp, Mp, P = key
                 fit = max_variants_for(
@@ -496,15 +497,33 @@ class BatchDispatcher:
                 )
                 width = max(min(self.max_batch, fit), 1)
                 for i in range(0, len(members), width):
-                    self._launch_chunk(key, members[i: i + width])
-                    n_chunks += 1
+                    waves.append((key, members[i: i + width]))
+            # wave streaming: stage wave 0, then dispatch wave k and
+            # stage wave k+1 back-to-back — the next wave's member-
+            # table upload overlaps the in-flight wave's (async) member
+            # dispatches, so N waves pay ONE batched fetch each with
+            # zero idle gap between them (the service-lane twin of the
+            # resident stream lane's double buffer)
+            staged = self._stage_chunk(*waves[0]) if waves else None
+            for j in range(len(waves)):
+                self._dispatch_chunk(staged)
+                n_chunks += 1
+                staged = (
+                    self._stage_chunk(*waves[j + 1])
+                    if j + 1 < len(waves) else None
+                )
         self.last_launch_compiles = counter.count if counter.supported \
             else -1
         if self.metrics is not None and counter.supported:
             self.metrics.record_service_compiles(counter.count)
         return n_chunks
 
-    def _launch_chunk(self, key: tuple, members: list) -> None:
+    def _stage_chunk(self, key: tuple, members: list):
+        """Stage one wave's member tables on device WITHOUT
+        dispatching: host-side stack + ONE batched upload. ``launch``
+        calls this one wave ahead of ``_dispatch_chunk`` so the upload
+        overlaps the previous wave's in-flight member dispatches (the
+        double buffer)."""
         Tp, Mp, P = key
         # grow-only batch-axis bucket: one compiled member-kernel shape
         # per (Tp, Mp, P) even as the tenant count churns
@@ -526,7 +545,17 @@ class BatchDispatcher:
         chunk = _Chunk(key=key, members=members, smax=smax)
         with no_implicit_transfers():
             stacked = jax.device_put(stacked_host)
-            up_ms = (time.perf_counter() - t0) * 1000
+        up_ms = (time.perf_counter() - t0) * 1000
+        return (chunk, stacked, zeros_t, zeros_m, idxs, up_ms)
+
+    def _dispatch_chunk(self, staged) -> None:
+        """Dispatch a staged wave's member kernels and start its ONE
+        batched background fetch."""
+        chunk, stacked, zeros_t, zeros_m, idxs, up_ms = staged
+        Tp, Mp, P = chunk.key
+        members = chunk.members
+        smax = chunk.smax
+        with no_implicit_transfers():
             chunk.t_dispatch = time.perf_counter()
             with enable_x64(True):
                 for i, m in enumerate(members):

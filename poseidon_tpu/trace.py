@@ -104,6 +104,10 @@ EVENT_TYPES = frozenset({
     "EXPRESS_PLACE",    # express-lane placement between round ticks
     "EXPRESS_CORRECTED",  # correction round moved an express placement
     "EXPRESS_DEGRADE",  # express batch fell back to the round path
+    "STREAM_FLUSH",     # one stream-lane flush: K accumulated windows
+                        # scanned as one device program with ONE fetch
+                        # (detail.windows/placements/fetches/
+                        # failed_window; ops/resident.py stream lane)
     "SPAN",             # per-round/per-batch phase span tree
                         # (--trace_profile; obs/spans.py schema)
     "FLIGHTREC_DUMP",   # the anomaly flight recorder wrote a dump

@@ -344,6 +344,43 @@ class TestScaleComposition:
         assert all(v >= 0 for v in seats.values()), seats
 
 
+class TestChangeCapOverflow:
+    """Regression (satellite): a certified repair whose changed-row
+    count overflows the compacted decision log must NOT be thrown away
+    — it degrades loudly to one extra full placement fetch, every
+    placement binds, and the context stays warm."""
+
+    def test_overflow_binds_everything_and_counts_degrade(self):
+        trace = TraceGenerator()
+        bridge, cluster = make_bridge(trace=trace)
+        bridge.solver.express_change_cap = 1
+        pods = [arrival(f"cc-{k}", cluster, k) for k in range(3)]
+        r = bridge.express_batch([("ADDED", p) for p in pods])
+        assert r is not None
+        assert sorted(r.bindings) == ["cc-0", "cc-1", "cc-2"]
+        # the context survived — the repair was certified, only the
+        # compacted log was truncated
+        assert bridge.solver.express_ready
+        why = next(e for e in trace.events
+                   if e.event == "EXPRESS_DEGRADE")
+        assert "change_cap" in why.detail["why"]
+        for uid, m in r.bindings.items():
+            bridge.confirm_binding(uid, m)
+        stats = bridge.run_scheduler().stats
+        assert stats.express_degrades == 1
+        assert stats.express_places == 3
+        # the overflow paid exactly one extra sanctioned fetch
+        assert bridge.solver.express_fetches >= 2
+
+    def test_under_cap_stays_on_compacted_path(self):
+        bridge, cluster = make_bridge()
+        bridge.solver.express_change_cap = 8
+        r = bridge.express_batch([("ADDED", arrival("uc-0", cluster))])
+        assert r is not None and list(r.bindings) == ["uc-0"]
+        bridge.confirm_binding("uc-0", r.bindings["uc-0"])
+        assert bridge.run_scheduler().stats.express_degrades == 0
+
+
 class TestRecompileBudget:
     def test_zero_steady_state_recompiles(self):
         bridge, cluster = make_bridge(n_machines=20, n_tasks=90, seed=7)
@@ -558,8 +595,8 @@ class TestExpressCliE2E:
         orig = ClusterWatcher.express_poll
         forced: list[bool] = []
 
-        def poll(self, timeout_s, max_events=16):
-            ev = orig(self, timeout_s, max_events=max_events)
+        def poll(self, timeout_s, max_events=16, **kw):
+            ev = orig(self, timeout_s, max_events=max_events, **kw)
             if ev.pod_events and not forced:
                 forced.append(True)
                 ev.needs_tick = True

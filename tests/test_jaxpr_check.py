@@ -24,7 +24,7 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 
 EXPECTED_KERNELS = {
     "solve", "resident_chain", "express_patch", "express_chain",
-    "solve_member",
+    "stream_chain", "solve_member",
 }
 
 

@@ -1,0 +1,519 @@
+"""The streaming lane: K express windows, ONE scan dispatch, ONE fetch.
+
+Covers the whole vertical: window accumulation + deferred solve
+(``ResidentSolver.stream_window`` / ``stream_flush`` /
+``stream_finish``), bit-identity against the synced express lane under
+churn x preemption x the scale lane (the differential fuzz harness —
+the acceptance gate), the 1-fetch-per-K-windows amortization contract,
+per-window certificate latching (a failed window binds the good prefix
+and degrades loudly), the zero steady-state recompile budget including
+draining flushes, the HBM budget charge for the event-stream buffer,
+and the multi-window watch poll (``express_poll_windows``).
+
+Harness rule the differential tests MUST follow: both bridges only
+agree on RUNNING membership at flush boundaries (the synced lane
+confirms per window, the stream lane per flush), so DELETED victims
+are drawn from ONE shared snapshot taken at cycle start — never from
+each bridge's own mid-cycle state.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.bridge import SchedulerBridge
+from poseidon_tpu.cluster import TaskPhase
+from poseidon_tpu.guards import CompileCounter
+from poseidon_tpu.synth import make_synthetic_cluster
+from poseidon_tpu.trace import TraceGenerator
+
+from tests.test_express import arrival
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def make_stream_bridge(n_machines=20, n_tasks=90, seed=3, *,
+                       stream_windows=3, trace=None, confirm=True,
+                       **kw):
+    """A bridge on the dense lane with the stream lane armed and one
+    certified round behind it, plus its cluster."""
+    cluster = make_synthetic_cluster(
+        n_machines, n_tasks, seed=seed, prefs_per_task=2,
+        **({"running_fraction": kw.pop("running_fraction")}
+           if "running_fraction" in kw else {}),
+    )
+    bridge = SchedulerBridge(
+        cost_model="quincy", small_to_oracle=False, express_lane=True,
+        stream_windows=stream_windows, trace=trace, **kw,
+    )
+    bridge.observe_nodes(list(cluster.machines))
+    bridge.observe_pods(list(cluster.tasks))
+    res = bridge.run_scheduler()
+    if confirm:
+        for uid, m in res.bindings.items():
+            bridge.confirm_binding(uid, m)
+    return bridge, cluster
+
+
+class TestStreamBasics:
+    def test_k_windows_one_flush_binds_all(self):
+        trace = TraceGenerator()
+        bridge, cluster = make_stream_bridge(stream_windows=3,
+                                             trace=trace)
+        t0 = time.perf_counter()
+        for w in range(3):
+            ok = bridge.stream_window(
+                [("ADDED", arrival(f"sw-{w}", cluster, w))],
+                t_event=t0,
+            )
+            assert ok
+        assert bridge.solver.stream_pending_windows == 3
+        bridge.stream_flush()
+        assert bridge.solver.stream_inflight
+        r = bridge.stream_finish()
+        assert r is not None
+        assert sorted(r.bindings) == ["sw-0", "sw-1", "sw-2"]
+        assert r.latency_ms > 0
+        # ONE fetch for the whole batch, all three windows real
+        assert bridge.solver.stream_fetches == 1
+        assert bridge.solver.last_stream_windows == 3
+        assert bridge.solver.last_stream_fetches == 1
+        events = {e.event for e in trace.events}
+        assert "STREAM_FLUSH" in events
+        assert "EXPRESS_PLACE" in events
+        flush_ev = next(e for e in trace.events
+                        if e.event == "STREAM_FLUSH")
+        assert flush_ev.detail["windows"] == 3
+        assert flush_ev.detail["placements"] == 3
+        assert flush_ev.detail["fetches"] == 1
+        assert flush_ev.detail["failed_window"] == -1
+        for uid, m in r.bindings.items():
+            bridge.confirm_binding(uid, m)
+        stats = bridge.run_scheduler().stats
+        assert stats.express_batches == 3   # one per good window
+        assert stats.express_places == 3
+        assert stats.express_degrades == 0
+
+    def test_short_flush_pads_with_noop_windows(self):
+        bridge, cluster = make_stream_bridge(stream_windows=4)
+        ok = bridge.stream_window([("ADDED", arrival("dr-0", cluster))])
+        assert ok
+        bridge.stream_flush()  # draining flush: 1 real window of 4
+        r = bridge.stream_finish()
+        assert r is not None and list(r.bindings) == ["dr-0"]
+        assert bridge.solver.last_stream_windows == 1
+        assert bridge.solver.stream_fetches == 1
+
+    def test_replay_noise_accumulates_nothing(self):
+        bridge, cluster = make_stream_bridge(stream_windows=3)
+        # drain the first round's retire backlog into a real window
+        bridge.stream_window([("ADDED", arrival("rn-0", cluster))])
+        bridge.stream_flush()
+        r = bridge.stream_finish()
+        bridge.confirm_binding("rn-0", r.bindings["rn-0"])
+        pending0 = bridge.solver.stream_pending_windows
+        # pure replay: the pod is already RUNNING locally
+        ok = bridge.stream_window([("ADDED", bridge.tasks["rn-0"])])
+        assert ok
+        assert bridge.solver.stream_pending_windows in (
+            pending0, pending0 + 1
+        )  # at most the confirm's retire window, never a placement
+        bridge.stream_flush()
+        r2 = bridge.stream_finish()
+        assert r2 is None or r2.bindings == {}
+
+    def test_buffer_overflow_degrades_loudly(self):
+        bridge, cluster = make_stream_bridge(stream_windows=2)
+        for w in range(2):
+            assert bridge.stream_window(
+                [("ADDED", arrival(f"of-{w}", cluster, w))]
+            )
+        # a third window without a flush cannot be represented
+        ok = bridge.stream_window(
+            [("ADDED", arrival("of-2", cluster))]
+        )
+        assert not ok
+        assert not bridge.solver.express_ready
+        res = bridge.run_scheduler()
+        assert res.stats.express_degrades == 1
+        # every event still reached bridge state via the round
+        assert all(f"of-{w}" in res.bindings for w in range(3))
+
+    def test_unconfirmed_stream_placement_blocks_next_window(self):
+        bridge, cluster = make_stream_bridge(stream_windows=2)
+        bridge.stream_window([("ADDED", arrival("uc-0", cluster))])
+        bridge.stream_flush()
+        r = bridge.stream_finish()
+        assert r is not None and "uc-0" in r.bindings
+        # no confirm: the POST is still on the wire
+        ok = bridge.stream_window([("ADDED", arrival("uc-1", cluster))])
+        assert not ok
+        res = bridge.run_scheduler()
+        assert res.stats.express_degrades == 1
+        assert "uc-1" in res.bindings
+
+    def test_begin_round_abandons_pending_windows(self):
+        bridge, cluster = make_stream_bridge(stream_windows=3)
+        bridge.stream_window([("ADDED", arrival("ab-0", cluster))])
+        assert bridge.solver.stream_pending_windows >= 1
+        res = bridge.run_scheduler()
+        assert bridge.solver.stream_pending_windows == 0
+        assert not bridge.solver.stream_inflight
+        # the abandoned window's pod was applied to bridge state at
+        # accumulate time, so the round places it
+        assert "ab-0" in res.bindings
+
+
+class TestStreamDifferential:
+    """The acceptance gate: the K-window scan composition is
+    bit-identical to K synced express dispatches — same placements,
+    same costs, same correction round — under churn, preemption, and
+    the scale lane."""
+
+    def _drive_pair(self, K, cycles, seed, *, preemption=False,
+                    opts=None):
+        kw = dict(opts or {})
+        if preemption:
+            kw.update(enable_preemption=True, migration_hysteresis=5,
+                      running_fraction=0.25)
+        elif "running_fraction" not in kw:
+            kw["running_fraction"] = 0.2
+        sync, cl_a = make_stream_bridge(
+            n_machines=16, n_tasks=70, seed=seed, stream_windows=0,
+            **kw,
+        )
+        strm, cl_b = make_stream_bridge(
+            n_machines=16, n_tasks=70, seed=seed, stream_windows=K,
+            **kw,
+        )
+        rng = np.random.default_rng(seed)
+        for cycle in range(cycles):
+            # the harness rule: victims come from ONE shared snapshot
+            # taken at the flush boundary, where both bridges agree
+            run_a = sorted(u for u, t in sync.tasks.items()
+                           if t.phase == TaskPhase.RUNNING)
+            run_b = sorted(u for u, t in strm.tasks.items()
+                           if t.phase == TaskPhase.RUNNING)
+            assert run_a == run_b
+            victims = list(run_a)
+            placed_sync: dict[str, str] = {}
+            schedule = []
+            for w in range(K):
+                arr = [
+                    (f"c{cycle}w{w}-{k}", int(rng.integers(16)),
+                     float(rng.choice([0.1, 0.2, 0.4])))
+                    for k in range(int(rng.integers(0, 3)))
+                ]
+                victim = None
+                if victims and rng.random() < 0.5:
+                    victim = victims.pop(int(rng.integers(
+                        len(victims))))
+                schedule.append((arr, victim))
+            # synced lane: solve + confirm per window
+            for arr, victim in schedule:
+                events = [
+                    ("ADDED", arrival(u, cl_a, k, cpu=c))
+                    for u, k, c in arr
+                ]
+                if victim is not None:
+                    events.append(("DELETED", sync.tasks[victim]))
+                r = sync.express_batch(events)
+                assert sync.solver.express_ready, "synced lane degraded"
+                for uid, m in (r.bindings if r else {}).items():
+                    placed_sync[uid] = m
+                    sync.confirm_binding(uid, m)
+            # stream lane: accumulate K windows, ONE flush
+            for arr, victim in schedule:
+                events = [
+                    ("ADDED", arrival(u, cl_b, k, cpu=c))
+                    for u, k, c in arr
+                ]
+                if victim is not None:
+                    events.append(("DELETED", strm.tasks[victim]))
+                assert strm.stream_window(events), (
+                    "stream window degraded"
+                )
+            strm.stream_flush()
+            r = strm.stream_finish()
+            placed_strm = dict(r.bindings) if r is not None else {}
+            for uid, m in placed_strm.items():
+                strm.confirm_binding(uid, m)
+            assert placed_strm == placed_sync, (
+                f"cycle {cycle}: stream placed {placed_strm}, "
+                f"synced placed {placed_sync}"
+            )
+        # the correction round sees identical graphs and agrees too
+        res_a = sync.run_scheduler()
+        res_b = strm.run_scheduler()
+        assert dict(res_b.bindings) == dict(res_a.bindings)
+        assert res_b.stats.cost == res_a.stats.cost
+        assert res_b.stats.pods_unscheduled == \
+            res_a.stats.pods_unscheduled
+        assert dict(res_b.migrations) == dict(res_a.migrations)
+        assert set(res_b.preemptions) == set(res_a.preemptions)
+        return sync, strm
+
+    @pytest.mark.parametrize("seed", [7, 19])
+    def test_churn_fuzz_bit_identical(self, seed):
+        sync, strm = self._drive_pair(3, 3, seed)
+        # the amortization actually happened: one fetch per flush on
+        # the stream side vs one per window on the synced side
+        assert strm.solver.stream_fetches == 3
+        assert sync.solver.express_fetches > \
+            strm.solver.express_fetches + strm.solver.stream_fetches
+
+    def test_preemption_mode_bit_identical(self):
+        # rebalancing mode: the running block's freeze applies before
+        # window 0 on both lanes, then migrations/preemptions in the
+        # correction round must agree
+        self._drive_pair(3, 2, 23, preemption=True)
+
+    @pytest.mark.parametrize("opts", [
+        {"aggregate_classes": True},
+        {"mesh_width": 1},
+        {"mesh_width": 8},
+    ])
+    def test_scale_lane_bit_identical(self, opts):
+        self._drive_pair(2, 2, 31, opts=opts)
+
+
+class TestStreamCertificate:
+    """Per-window latching: a failed window freezes the carry, binds
+    the good prefix, and degrades loudly — never a silent partial
+    commit."""
+
+    def test_failed_first_window_binds_nothing_and_degrades(self):
+        trace = TraceGenerator()
+        bridge, cluster = make_stream_bridge(stream_windows=2,
+                                             trace=trace)
+        # cap 0: any placement overflows the compacted log — unlike
+        # the synced lane (which degrades to a full fetch of certified
+        # state), a mid-scan window cannot fetch, so it latches dead
+        bridge.solver.express_change_cap = 0
+        for w in range(2):
+            assert bridge.stream_window(
+                [("ADDED", arrival(f"cf-{w}", cluster, w))]
+            )
+        bridge.stream_flush()
+        r = bridge.stream_finish()
+        assert r is None  # nothing bound, stream degraded
+        assert not bridge.solver.express_ready
+        flush_ev = next(e for e in trace.events
+                        if e.event == "STREAM_FLUSH")
+        assert flush_ev.detail["failed_window"] == 0
+        assert flush_ev.detail["placements"] == 0
+        why = next(e for e in trace.events
+                   if e.event == "EXPRESS_DEGRADE")
+        assert "window 0" in why.detail["why"]
+        assert "change_cap" in why.detail["why"]
+        res = bridge.run_scheduler()
+        assert res.stats.express_degrades == 1
+        # the failed windows' events still bind via the round
+        assert all(f"cf-{w}" in res.bindings for w in range(2))
+
+    def test_good_prefix_binds_before_failed_window(self):
+        trace = TraceGenerator()
+        bridge, cluster = make_stream_bridge(stream_windows=3,
+                                             trace=trace)
+        # cap 1: a one-arrival window certifies (1 changed row), a
+        # two-arrival window overflows and latches the stream there
+        bridge.solver.express_change_cap = 1
+        assert bridge.stream_window(
+            [("ADDED", arrival("gp-0", cluster, 0))]
+        )
+        assert bridge.stream_window(
+            [("ADDED", arrival("gp-1a", cluster, 1)),
+             ("ADDED", arrival("gp-1b", cluster, 2))]
+        )
+        bridge.stream_flush()
+        r = bridge.stream_finish()
+        # window 0's placement binds; window 1 onward waits for the
+        # round
+        assert r is not None and list(r.bindings) == ["gp-0"]
+        assert not bridge.solver.express_ready
+        flush_ev = next(e for e in trace.events
+                        if e.event == "STREAM_FLUSH")
+        assert flush_ev.detail["failed_window"] == 1
+        bridge.confirm_binding("gp-0", r.bindings["gp-0"])
+        res = bridge.run_scheduler()
+        assert res.stats.express_degrades == 1
+        assert "gp-1a" in res.bindings and "gp-1b" in res.bindings
+        assert "gp-0" not in res.bindings  # already confirmed
+
+
+class TestStreamRecompileBudget:
+    def test_zero_steady_state_recompiles_including_draining(self):
+        bridge, cluster = make_stream_bridge(
+            n_machines=20, n_tasks=90, seed=7, stream_windows=3,
+        )
+
+        def cycle(uids, flush_at):
+            for i, uid in enumerate(uids):
+                assert bridge.stream_window(
+                    [("ADDED", arrival(uid, cluster, i))]
+                )
+                if bridge.solver.stream_pending_windows >= flush_at:
+                    bridge.stream_flush()
+                    r = bridge.stream_finish()
+                    for u, m in (r.bindings if r else {}).items():
+                        bridge.confirm_binding(u, m)
+            if bridge.solver.stream_pending_windows:
+                bridge.stream_flush()
+                r = bridge.stream_finish()
+                for u, m in (r.bindings if r else {}).items():
+                    bridge.confirm_binding(u, m)
+
+        # warm both program variants: a full K=3 flush and a draining
+        # (padded) short flush
+        cycle([f"warm-{k}" for k in range(3)], 3)
+        cycle(["warm-3"], 3)
+        cycle([f"warm2-{k}" for k in range(4)], 3)
+        counter = CompileCounter()
+        with counter:
+            cycle([f"st-{k}" for k in range(3)], 3)   # full flush
+            cycle(["st-3"], 3)                         # draining
+            cycle([f"st2-{k}" for k in range(5)], 3)   # full + short
+        if not counter.supported:
+            pytest.skip("this jax exposes no compile-monitoring hook")
+        assert counter.count == 0, (
+            f"{counter.count} steady-state recompile(s) on the "
+            f"stream path"
+        )
+
+
+class TestStreamBudget:
+    def test_event_buffer_charged_and_hint_names_fitting_k(self):
+        from poseidon_tpu.ops.dense_auction import (
+            DenseMemoryTooLarge,
+            check_table_budget,
+            max_stream_windows_for,
+        )
+
+        # a shape that fits without the stream buffer but not with a
+        # huge K: the raise must name the largest K that fits
+        Tp, Mp = 4096, 2048
+        stream_ints = 5_000_000
+        check_table_budget(Tp, Mp)  # base fits
+        fit = max_stream_windows_for(Tp, Mp, stream_ints)
+        assert fit >= 1
+        with pytest.raises(DenseMemoryTooLarge) as ei:
+            check_table_budget(
+                Tp, Mp, stream_windows=fit + 64,
+                stream_ints=stream_ints,
+            )
+        msg = str(ei.value)
+        assert f"--stream_windows={fit}" in msg
+        assert "stream event buffer" in msg
+
+    def test_fitting_k_passes(self):
+        from poseidon_tpu.ops.dense_auction import (
+            check_table_budget,
+            max_stream_windows_for,
+        )
+
+        Tp, Mp = 4096, 2048
+        stream_ints = 5_000_000
+        fit = max_stream_windows_for(Tp, Mp, stream_ints)
+        check_table_budget(
+            Tp, Mp, stream_windows=fit, stream_ints=stream_ints,
+        )
+
+
+class TestStreamMetrics:
+    def test_flush_records_fetch_lane_and_amortization_gauge(self):
+        from poseidon_tpu.obs import MetricsRegistry, SchedulerMetrics
+
+        m = SchedulerMetrics(MetricsRegistry())
+        bridge, cluster = make_stream_bridge(stream_windows=2,
+                                             metrics=m)
+        for w in range(2):
+            assert bridge.stream_window(
+                [("ADDED", arrival(f"mx-{w}", cluster, w))]
+            )
+        bridge.stream_flush()
+        r = bridge.stream_finish()
+        assert r is not None and len(r.bindings) == 2
+        text = m.registry.render()
+        assert 'poseidon_solver_fetches_total{lane="stream"} 1' in text
+        assert "poseidon_stream_flushes_total 1" in text
+        assert "poseidon_placements_per_fetch 2" in text
+
+
+class TestWatchStreamWindows:
+    """ClusterWatcher.express_poll_windows: the stream driver's
+    multi-window event source."""
+
+    def _server(self, n_nodes=4, n_pods=6):
+        from poseidon_tpu.apiclient import FakeApiServer, K8sApiClient
+
+        server = FakeApiServer().start()
+        for i in range(n_nodes):
+            server.add_node(f"n{i}", cpu="8", memory="16Gi", pods=8)
+        for j in range(n_pods):
+            server.add_pod(f"p{j}", cpu="100m", memory="64Mi")
+        return server, K8sApiClient("127.0.0.1", server.port)
+
+    def test_backlog_splits_into_windows(self):
+        from poseidon_tpu.apiclient import ClusterWatcher
+
+        server, client = self._server()
+        watcher = ClusterWatcher(client, max_lag_s=120.0)
+        try:
+            watcher.tick()
+            for k in range(3):
+                server.add_pod(f"late-{k}", cpu="100m", memory="64Mi")
+            assert watcher.wait_caught_up(server.current_rv(), 10.0)
+            evs = watcher.express_poll_windows(
+                1.0, max_events=1, windows=3
+            )
+            assert len(evs) == 3
+            assert [t.uid for ev in evs for _typ, t in ev.pod_events] \
+                == [f"default/late-{k}" for k in range(3)]
+            # only the first window blocked; none requested a tick
+            assert not any(ev.needs_tick for ev in evs)
+        finally:
+            watcher.stop()
+            server.stop()
+
+    def test_dry_stream_stops_after_first_empty_window(self):
+        from poseidon_tpu.apiclient import ClusterWatcher
+
+        server, client = self._server()
+        watcher = ClusterWatcher(client, max_lag_s=120.0)
+        try:
+            watcher.tick()
+            server.add_pod("only-0", cpu="100m", memory="64Mi")
+            assert watcher.wait_caught_up(server.current_rv(), 10.0)
+            evs = watcher.express_poll_windows(
+                1.0, max_events=8, windows=4
+            )
+            # one real window; the drain stops at the first empty one
+            # rather than burning the remaining window slots
+            assert len(evs) <= 2
+            assert [t.uid for _typ, t in evs[0].pod_events] == [
+                "default/only-0"
+            ]
+        finally:
+            watcher.stop()
+            server.stop()
+
+    def test_needs_tick_only_in_last_window(self):
+        from poseidon_tpu.apiclient import ClusterWatcher
+
+        server, client = self._server()
+        watcher = ClusterWatcher(client, max_lag_s=120.0)
+        try:
+            watcher.tick()
+            server.add_pod("pre-n", cpu="100m", memory="64Mi")
+            assert watcher.wait_caught_up(server.current_rv(), 10.0)
+            server.add_node("n-new", cpu="8", memory="16Gi", pods=8)
+            assert watcher.wait_caught_up(server.current_rv(), 10.0)
+            evs = watcher.express_poll_windows(
+                2.0, max_events=1, windows=4
+            )
+            assert evs[-1].needs_tick
+            assert not any(ev.needs_tick for ev in evs[:-1])
+        finally:
+            watcher.stop()
+            server.stop()

@@ -180,6 +180,35 @@ class Contracts:
     trace_vocab_name: str = "EVENT_TYPES"
     flag_module: str = "poseidon_tpu/cli.py"
     flag_doc_files: tuple[str, ...] = ("README.md", "deploy/poseidon-tpu.cfg")
+    # metric-name drift: every ``poseidon_*`` family registered in the
+    # metrics module must appear in the doc file's observability
+    # reference, and every family the doc names must still be
+    # registered (a renamed family silently orphans dashboards)
+    metrics_module: str = "poseidon_tpu/obs/metrics.py"
+    metrics_doc_file: str = "README.md"
+
+    # ---- PTA009: per-kernel mask contracts ----------------------------
+    # kernel name -> ((primitive, function, reason), ...): reductions
+    # that consume padding-tainted operands SAFELY — the padded lanes
+    # are benign by construction (INF fills, zero-weight rows) rather
+    # than by a visible select_n mask. Verified live both ways: an
+    # unsanctioned tainted reduction is a violation, and a sanction no
+    # trace exercises is reported stale (the PTA006 handoff
+    # discipline).
+    kernel_mask_contracts: dict[
+        str, tuple[tuple[str, str, str], ...]
+    ] = dataclasses.field(default_factory=dict)
+
+    # ---- PTA010: lock-order + no-blocking-under-lock ------------------
+    # terminal callable/method names that BLOCK (filesystem barriers,
+    # apiserver round-trips, solver dispatch): executing one while any
+    # lock is held stalls every thread contending that lock for the
+    # call's full latency. ``.join()``/``queue.put(block=True)`` are
+    # recognized structurally by the rule; this vocabulary covers the
+    # repo's I/O surface. Plain buffered ``.write``/``.flush`` are NOT
+    # blocking (page-cache writes — the journal's write-under-lock is
+    # by design; only the fsync barrier must leave the region).
+    blocking_call_names: tuple[str, ...] = ()
 
 
 # The marker comment declaring a function runs on a background thread
@@ -518,5 +547,79 @@ DEFAULT_CONTRACTS = Contracts(
     },
     path_rules=(
         ("tests/", ("PTA000", "PTA003", "PTA005")),
+    ),
+    kernel_mask_contracts={
+        # "*" = every kernel whose trace reaches the site (the solve
+        # family shares these). Each entry is a reduction that folds
+        # padded lanes SAFELY by construction — the identity the fold
+        # needs is already baked into the table, so masking at the
+        # fold would buy nothing and cost a select per inner-loop
+        # call. Verified live: an entry with no matching tainted
+        # reduction in the current traces is reported stale.
+        "*": (
+            ("reduce_min", "_task_options",
+             "folds dev.c + p over the machine axis: padded machine "
+             "columns are INF-filled at construction "
+             "(build_dense_instance/build_member_tables), so they "
+             "never win a min; padded TASK rows produce garbage rows "
+             "consumed only under task_valid"),
+            ("argmin", "_task_options",
+             "same table as the reduce_min above: INF padded columns "
+             "lose every argmin; ties resolve inside the valid "
+             "machine set"),
+            ("reduce_min", "_theta_clearing",
+             "the analytic-init seat market folds dev.c with the "
+             "same INF-filled padded columns; stage-one lambda is "
+             "already zeroed on zero-slot machines via dev.s > 0"),
+            ("reduce_min", "auction_round",
+             "the bid window's per-task best-value fold over "
+             "gathered dev.c rows: INF padded columns, and bidder "
+             "positions come from the sorted carry where padded "
+             "tasks ride the DUMP segment"),
+            ("reduce_or", "body",
+             "any(waiting): layout() computes waiting = (in-machine "
+             "& unseated) | WAIT over the sorted carry — padded "
+             "tasks sit in the DUMP segment, never WAIT, so they "
+             "cannot hold the loop open"),
+            ("reduce_or", "phase_shift",
+             "any(violators(...)): violators() ANDs dev.task_valid "
+             "into the mask before returning, padded rows cannot "
+             "trigger a refight"),
+            ("reduce_or", "tighten",
+             "any(viol)/any(stranded): violator masks AND in "
+             "task_valid; stranded masks AND in dev.s > 0, which "
+             "excludes zero-slot padded machines by the pad "
+             "contract"),
+            ("reduce_sum", "_solve",
+             "the dual's machine-side term sums dev.s * lambda: "
+             "padded machines carry s == 0 by the pad contract, "
+             "contributing exact zeros to the certificate"),
+        ),
+    },
+    blocking_call_names=(
+        # filesystem barrier: the one call whose whole point is to
+        # WAIT for the platters/flash
+        "fsync",
+        # apiserver round-trips (apiclient/client.py surface): each is
+        # an HTTP request with network latency and retry loops
+        "get_pod",
+        "bind_pod_to_node",
+        "evict_pod",
+        "bind_outcome",
+        "evict_outcome",
+        "list_pods",
+        "list_nodes",
+        "urlopen",
+        "getresponse",
+        "sendall",
+        # solver dispatch / device sync: a round or a fetch pinned
+        # under a lock serializes the daemon on kernel latency
+        "run_round",
+        "solve_scheduling",
+        "block_until_ready",
+        "device_get",
+        # deliberate delay: sleeping under a lock turns an injected
+        # or polled delay into a stall for every contender
+        "sleep",
     ),
 )

@@ -330,6 +330,7 @@ def _ensure_rules_loaded() -> None:
     """Rule registration is an import-time side effect of the rule
     modules; every public entry point must force it or it would run
     with an empty registry and report anything as clean."""
+    import poseidon_tpu.analysis.locks  # noqa: F401 (registry side effect)
     import poseidon_tpu.analysis.recompile  # noqa: F401 (registry side effect)
     import poseidon_tpu.analysis.rules  # noqa: F401 (registry side effect)
     import poseidon_tpu.analysis.threads  # noqa: F401 (registry side effect)

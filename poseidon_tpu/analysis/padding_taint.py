@@ -1,0 +1,393 @@
+"""PTA009: padding-mask dataflow audit over the traced kernels.
+
+PTA008 pins WHAT the compiled programs contain (primitive census,
+consts, dtypes); this pass checks HOW values flow through them. Every
+real exactness bug this repo has shipped and then caught — the padded-
+row leaks the bit-identity fuzzers found, the express-lane cost
+regressions — was the same shape: a PADDED lane (a row beyond the
+t/m/p grow-only floors, a ``-1`` index sentinel, a zero-slot machine;
+the contract declared at ``ops/resident.py`` ``DenseTopology``)
+escaping into a cross-axis reduction without a dominating mask. The
+reduction then folds garbage — a model-priced zero pod, an INF that
+was supposed to be masked, a stale level — into a scalar the whole
+round trusts.
+
+The analysis is a forward taint pass over the closed jaxprs of the
+production kernels (the same traces PTA008 audits):
+
+- **sources**: every array-rank kernel input is padding-tainted —
+  by the pad contract every table carries lanes beyond the true
+  t/m/p extents (scalars like ``n_tasks`` / epoch counters are
+  clean); iota/literal-derived index math stays clean;
+- **propagation**: elementwise ops, gathers, scatters, sorts,
+  cumsums, slices — any tainted input taints the outputs; nested
+  ``pjit``/``scan``/``while``/``cond`` bodies are entered with the
+  call-site taint (carries run to a fixpoint);
+- **sinks**: a cross-axis reduction (``reduce_min/max/sum/prod``,
+  ``argmin/argmax``, ``reduce_and/or``) folding a padding-tainted
+  operand fires unless the mask DOMINATES the fold: the operand is
+  the output of ``select_n`` (``jnp.where``'s lowering) or ``clamp``,
+  reached through dtype/layout-transparent ops only
+  (``convert_element_type``, ``reshape``, ``broadcast_in_dim``, ...).
+  This is exactly the repo's established fold idiom —
+  ``finmax``/``finmin``/``gat`` in ``ops/resident.py`` apply
+  ``jnp.where`` INSIDE the reduction call. A mask applied further
+  upstream does NOT count: the express-lane bug this pass exists to
+  catch was a fold over model output that WAS where-masked upstream —
+  on the wrong axis (arc validity, not arrival-slot validity). Mask
+  at the fold, or sanction the site. Counting folds over bool masks
+  (``jnp.sum(report)``) are exempt — mask algebra is how padding
+  predicates are BUILT — but ``reduce_and/or`` over an unmasked
+  tainted mask still fire (an unmasked ``jnp.all`` is how a padded
+  row poisons a convergence certificate).
+
+Reductions that are safe by CONSTRUCTION rather than by a visible
+mask (e.g. ``_task_options`` folding ``dev.c`` columns the builder
+already filled with INF) are sanctioned in
+``Contracts.kernel_mask_contracts`` — one reasoned entry per
+(kernel, primitive, function). The sanction list is verified live in
+both directions, the same discipline as the PTA006 handoff allowlist:
+an entry no current trace exercises is reported as STALE.
+
+The acceptance tests keep the pass honest the way PR 10 did —
+reverting the real ``_express_step`` arrival-lane mask must fire
+PTA009.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import numpy as np
+
+from poseidon_tpu.analysis.core import Violation
+
+# cross-axis folds: these collapse lanes, so a padded lane reaching
+# one unmasked contaminates the scalar/row the whole kernel trusts
+_ARITH_SINKS = frozenset({
+    "reduce_min", "reduce_max", "reduce_sum", "reduce_prod",
+    "argmin", "argmax",
+})
+_BOOL_SINKS = frozenset({"reduce_and", "reduce_or"})
+_REDUCE_SINKS = _ARITH_SINKS | _BOOL_SINKS
+
+# dominating-mask producers
+_MASK_PRIMS = frozenset({"select_n", "clamp"})
+
+# ops transparent to mask domination: they change dtype/layout, never
+# lane contents, so a select_n stays dominating through them
+_TRANSPARENT = frozenset({
+    "convert_element_type", "reshape", "squeeze", "broadcast_in_dim",
+    "transpose", "copy", "stop_gradient",
+})
+
+# higher-order primitives whose bodies are entered positionally
+# (pjit/closed_call: body invars mirror the eqn invars)
+_CALL_PRIMS = frozenset({
+    "pjit", "closed_call", "core_call", "remat2", "checkpoint",
+    "custom_jvp_call", "custom_vjp_call",
+})
+
+
+def _is_literal(atom) -> bool:
+    # jax Literals carry .val; Vars don't (duck-typed across versions)
+    return hasattr(atom, "val")
+
+
+def _is_bool_var(var) -> bool:
+    aval = getattr(var, "aval", None)
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return False
+    try:
+        return np.dtype(dtype) == np.bool_
+    except TypeError:
+        return False
+
+
+def _rank(var) -> int:
+    aval = getattr(var, "aval", None)
+    return len(getattr(aval, "shape", ()) or ())
+
+
+def _user_frame(eqn):
+    """(file_name, function_name, line) of the trace-time user frame,
+    best-effort across jax versions."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+    except Exception:
+        frame = None
+    if frame is None:
+        return None, None, 0
+    return (
+        getattr(frame, "file_name", None),
+        getattr(frame, "function_name", None),
+        int(getattr(frame, "start_line", 0) or 0),
+    )
+
+
+class _Candidate:
+    """One unmasked tainted reduction site (pre-sanction)."""
+
+    __slots__ = ("kernel", "primitive", "function", "file", "line")
+
+    def __init__(self, kernel, primitive, function, file, line):
+        self.kernel = kernel
+        self.primitive = primitive
+        self.function = function
+        self.file = file
+        self.line = line
+
+    def key(self):
+        return (self.kernel, self.primitive, self.function, self.line)
+
+
+class _State:
+    """(tainted, masked, boolish) per var. ``tainted`` grows
+    monotonically, ``masked`` (select_n-dominated through transparent
+    ops) shrinks — both converge under the carry fixpoints.
+    ``boolish`` marks a bool value or its dtype-converted image
+    (``jnp.sum(mask, dtype=...)`` converts before reducing — the
+    counting exemption must survive that)."""
+
+    __slots__ = ("taint", "masked", "boolish")
+
+    def __init__(self):
+        self.taint: dict = {}
+        self.masked: dict = {}
+        self.boolish: dict = {}
+
+    def get(self, atom) -> tuple[bool, bool, bool]:
+        if _is_literal(atom):
+            return False, True, False  # a literal is trivially safe
+        return (self.taint.get(atom, False),
+                self.masked.get(atom, False),
+                self.boolish.get(atom, False))
+
+    def put(self, var, tainted: bool, masked: bool,
+            boolish: bool) -> None:
+        self.taint[var] = bool(tainted)
+        self.masked[var] = bool(masked)
+        self.boolish[var] = bool(boolish) or _is_bool_var(var)
+
+
+def _run_jaxpr(jaxpr, in_flags, kernel, out):
+    """Forward (taint, masked) pass over one open jaxpr given per-
+    invar flags; returns outvar flags. ``out`` is the shared candidate
+    dict keyed for dedup (fixpoint re-runs re-report the same
+    sites)."""
+    st = _State()
+    for v, (t, m, b) in zip(jaxpr.invars, in_flags):
+        st.put(v, t, m, b)
+    for v in jaxpr.constvars:
+        st.put(v, False, False, False)
+
+    _merge = lambda a, c: (a[0] or c[0], a[1] and c[1], a[2] and c[2])
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        ins = [st.get(a) for a in eqn.invars]
+        any_taint = any(t for t, _, _ in ins)
+        params = eqn.params
+        out_flags: list[tuple[bool, bool, bool]] | None = None
+
+        if name in _CALL_PRIMS:
+            closed = params.get("jaxpr") or params.get("call_jaxpr")
+            if closed is not None and hasattr(closed, "jaxpr"):
+                body = closed.jaxpr
+                mapped = ins[: len(body.invars)]
+                mapped += [(False, False, False)] * (
+                    len(body.invars) - len(mapped)
+                )
+                outs = _run_jaxpr(body, mapped, kernel, out)
+                out_flags = list(outs[: len(eqn.outvars)])
+        elif name == "scan":
+            closed = params.get("jaxpr")
+            if closed is not None:
+                body = closed.jaxpr
+                nc = int(params.get("num_consts", 0))
+                ncar = int(params.get("num_carry", 0))
+                consts = ins[:nc]
+                carry = list(ins[nc:nc + ncar])
+                xs = ins[nc + ncar:]
+                # fixpoint: taint only grows, masked only shrinks
+                for _ in range(2 * ncar + 1):
+                    outs = _run_jaxpr(
+                        body, consts + carry + xs, kernel, out
+                    )
+                    new = [
+                        _merge(a, c)
+                        for a, c in zip(carry, outs[:ncar])
+                    ]
+                    if new == carry:
+                        break
+                    carry = new
+                out_flags = list(carry) + list(outs[ncar:])
+        elif name == "while":
+            cond_c = params.get("cond_jaxpr")
+            body_c = params.get("body_jaxpr")
+            if cond_c is not None and body_c is not None:
+                cn = int(params.get("cond_nconsts", 0))
+                bn = int(params.get("body_nconsts", 0))
+                cc = ins[:cn]
+                bc = ins[cn:cn + bn]
+                carry = list(ins[cn + bn:])
+                for _ in range(2 * len(carry) + 1):
+                    _run_jaxpr(cond_c.jaxpr, cc + carry, kernel, out)
+                    outs = _run_jaxpr(
+                        body_c.jaxpr, bc + carry, kernel, out
+                    )
+                    new = [_merge(a, c) for a, c in zip(carry, outs)]
+                    if new == carry:
+                        break
+                    carry = new
+                out_flags = list(carry)
+        elif name == "cond":
+            branches = params.get("branches") or ()
+            if branches:
+                ops = ins[1:]  # invars[0] is the branch index
+                acc = [(False, True, True)] * len(eqn.outvars)
+                for br in branches:
+                    outs = _run_jaxpr(br.jaxpr, ops, kernel, out)
+                    acc = [_merge(a, c) for a, c in zip(acc, outs)]
+                out_flags = acc
+
+        if name in _REDUCE_SINKS:
+            axes = params.get("axes", ())
+            cross_axis = axes is None or len(tuple(axes)) > 0
+            unmasked_taint = any(
+                t and not m and (name in _BOOL_SINKS or not b)
+                for (t, m, b), a in zip(ins, eqn.invars)
+                if not _is_literal(a)
+            )
+            if cross_axis and unmasked_taint:
+                fname, func, line = _user_frame(eqn)
+                cand = _Candidate(kernel, name, func, fname, line)
+                out.setdefault(cand.key(), cand)
+
+        if out_flags is None:
+            if name in _MASK_PRIMS:
+                # the fold-dominating mask forms; taint stops here
+                # from the sinks' point of view
+                out_flags = [
+                    (any_taint, True, False)
+                ] * len(eqn.outvars)
+            elif name in _TRANSPARENT:
+                out_flags = [
+                    ins[0] if ins else (False, False, False)
+                ] * len(eqn.outvars)
+            else:
+                out_flags = [
+                    (any_taint, False, False)
+                ] * len(eqn.outvars)
+
+        for v, (t, m, b) in zip(eqn.outvars, out_flags):
+            st.put(v, t, m, b)
+
+    return [st.get(v) for v in jaxpr.outvars]
+
+
+def analyze_kernel(name: str, closed) -> list[_Candidate]:
+    """All unmasked tainted reductions in one closed jaxpr. Sources:
+    every array-rank kernel input (the pad contract: all tables carry
+    padded lanes); scalars and closure consts are clean."""
+    out: dict = {}
+    in_flags = [
+        (_rank(v) >= 1, False, _is_bool_var(v))
+        for v in closed.jaxpr.invars
+    ]
+    _run_jaxpr(closed.jaxpr, in_flags, name, out)
+    return sorted(
+        out.values(),
+        key=lambda c: (c.kernel, c.function or "", c.line,
+                       c.primitive),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the audit entry point
+# ---------------------------------------------------------------------------
+
+
+def run_padding_audit(
+    root: pathlib.Path, *, traces=None, contracts=None
+) -> tuple[list[Violation], int]:
+    """Run the taint pass over the production kernel set and reconcile
+    against ``Contracts.kernel_mask_contracts``. Returns (violations,
+    kernels audited). ``traces`` reuses an already-traced set (one
+    trace drives PTA008 and PTA009)."""
+    from poseidon_tpu.analysis.contracts import DEFAULT_CONTRACTS
+    from poseidon_tpu.analysis.jaxpr_check import (
+        trace_production_kernels,
+    )
+
+    if contracts is None:
+        contracts = DEFAULT_CONTRACTS
+    if traces is None:
+        traces = trace_production_kernels()
+
+    violations: list[Violation] = []
+    # "*" sanctions every kernel tracing the site: the solve-family
+    # internals (_task_options, auction_round, ...) appear in five of
+    # the six traces — per-kernel entries would be sixfold noise
+    sanctioned = {
+        (kernel, prim, func): reason
+        for kernel, entries in contracts.kernel_mask_contracts.items()
+        for prim, func, reason in entries
+    }
+    used: set = set()
+
+    root = pathlib.Path(root).resolve()
+    for kernel in sorted(traces):
+        for cand in analyze_kernel(kernel, traces[kernel]):
+            skey = (kernel, cand.primitive, cand.function)
+            wkey = ("*", cand.primitive, cand.function)
+            hit = skey if skey in sanctioned else (
+                wkey if wkey in sanctioned else None
+            )
+            if hit is not None:
+                used.add(hit)
+                continue
+            path = "poseidon_tpu/analysis/kernel_fingerprints.json"
+            line = 1
+            if cand.file:
+                p = pathlib.Path(cand.file)
+                try:
+                    path = p.resolve().relative_to(root).as_posix()
+                except ValueError:
+                    path = p.as_posix()
+                line = cand.line or 1
+            violations.append(Violation(
+                code="PTA009", rule="padding-taint",
+                path=path, line=line, col=0,
+                message=(
+                    f"{kernel}: {cand.primitive} in "
+                    f"{cand.function or '<unknown>'} folds a padding-"
+                    "tainted operand with no dominating mask — padded "
+                    "lanes (rows beyond the t/m/p floors, -1 "
+                    "sentinels, zero-slot machines) reach this "
+                    "reduction unmasked; fold through jnp.where(valid,"
+                    " x, <identity>) at the reduction, or add a "
+                    "reasoned entry to Contracts.kernel_mask_contracts"
+                ),
+            ))
+
+    # stale-sanction audit (the PTA006 handoff discipline): an entry
+    # the current traces never exercise silently blesses the NEXT
+    # unmasked reduction someone writes at that site
+    for skey in sorted(set(sanctioned) - used,
+                       key=lambda k: (k[0], k[2] or "", k[1])):
+        kernel, prim, func = skey
+        violations.append(Violation(
+            code="PTA009", rule="padding-taint",
+            path="poseidon_tpu/analysis/contracts.py", line=1, col=0,
+            message=(
+                f"stale kernel_mask_contracts entry: ({prim!r}, "
+                f"{func!r}) in kernel {kernel!r} matches no tainted "
+                "reduction in the current traces — the site was "
+                "masked or removed; delete the entry"
+            ),
+        ))
+    return violations, len(traces)

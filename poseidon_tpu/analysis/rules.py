@@ -685,4 +685,92 @@ def surface_consistency(repo: RepoContext) -> list[Violation]:
                             f"flag {flag} is not documented in {doc}"
                         ),
                     ))
+
+    # -- metric family surface --
+    # every poseidon_* family registered in the metrics module must
+    # appear in the README's observability reference and vice versa:
+    # an operator alerting on a renamed family pages on silence, and a
+    # documented-but-unregistered family is a dashboard query that
+    # matches nothing. Same drift-proofing shape as the trace
+    # vocabulary above: the code side is the AST (literal first args
+    # to .counter/.gauge/.histogram), the doc side is a token scan.
+    metrics_ctx = next(
+        (f for rel, f in repo.files.items()
+         if rel.endswith(c.metrics_module)),
+        None,
+    )
+    if metrics_ctx is not None:
+        registered: dict[str, tuple[int, int]] = {}
+        for node in ast.walk(metrics_ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in (
+                        "counter", "gauge", "histogram")
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str) and \
+                    arg.value.startswith("poseidon_"):
+                registered.setdefault(
+                    arg.value, (node.lineno, node.col_offset)
+                )
+        doc_text = repo.read_text(c.metrics_doc_file)
+        if doc_text is None:
+            out.append(Violation(
+                code="PTA005", rule="surface-consistency",
+                path=metrics_ctx.path, line=1, col=0,
+                message=(
+                    f"metric doc file '{c.metrics_doc_file}' not found"
+                ),
+            ))
+        elif registered:
+            documented = {
+                m for m in re.findall(
+                    r"\bposeidon_[a-z0-9_]+", doc_text
+                )
+                if not m.startswith("poseidon_tpu")
+            }
+            for name in sorted(registered):
+                if name not in documented:
+                    line, col = registered[name]
+                    out.append(Violation(
+                        code="PTA005", rule="surface-consistency",
+                        path=metrics_ctx.path, line=line, col=col,
+                        message=(
+                            f"metric family '{name}' is registered "
+                            f"but not documented in "
+                            f"{c.metrics_doc_file}'s observability "
+                            "reference"
+                        ),
+                    ))
+            # reverse direction: histogram exports add per-series
+            # _bucket/_sum/_count suffixes, so strip those before
+            # deciding a documented token names a missing family; a
+            # token ending in '_' is a prose prefix reference
+            # ("the poseidon_outbox_* family") — fine as long as some
+            # registered family matches, but it does NOT satisfy the
+            # forward per-family requirement above
+            def _family(tok: str) -> str:
+                for suf in ("_bucket", "_sum", "_count"):
+                    if tok.endswith(suf) and \
+                            tok[: -len(suf)] in registered:
+                        return tok[: -len(suf)]
+                return tok
+            for tok in sorted(documented):
+                if tok.endswith("_") and any(
+                    name.startswith(tok) for name in registered
+                ):
+                    continue
+                if _family(tok) not in registered:
+                    out.append(Violation(
+                        code="PTA005", rule="surface-consistency",
+                        path=metrics_ctx.path, line=1, col=0,
+                        message=(
+                            f"{c.metrics_doc_file} documents metric "
+                            f"family '{tok}' that is not registered "
+                            f"in {c.metrics_module} — delete the "
+                            "stale reference or register the family"
+                        ),
+                    ))
     return out

@@ -142,8 +142,19 @@ class ActuationJournal:
                     "t_event_us": int(op.get("t_event_us", 0)),
                 }) + "\n")
             self._fh.flush()
-            if self.fsync:
-                os.fsync(self._fh.fileno())
+            fd = self._fh.fileno()
+        # the fsync BARRIER runs outside the lock: holding it would
+        # stall the POST pool's _mark() calls for the disk's full
+        # flush latency (the PTA010 no-blocking-under-lock class).
+        # Correctness is unchanged: the intent lines are ordered by
+        # the buffered writes above, a concurrent _mark that slips in
+        # before the barrier merely gets persisted early, and the
+        # crash-consistency contract — fsync before the first byte on
+        # the wire — holds because we still return only after the
+        # barrier. rotate() cannot close this fd concurrently: rotate
+        # and intents are both driver-thread ops.
+        if self.fsync:
+            os.fsync(fd)
         if self.crash_hook is not None:
             self.crash_hook("after-intent")
         return seqs
@@ -222,7 +233,15 @@ class ActuationJournal:
                         }) + "\n")
                 fh.flush()
                 if self.fsync:
-                    os.fsync(fh.fileno())
+                    # the tmp-file fsync must stay inside the lock:
+                    # the lock covers the whole tmp-write -> fsync ->
+                    # os.replace swap, or a _mark() landing between
+                    # barrier and swap would be written to the file
+                    # the replace is about to discard. rotate runs at
+                    # checkpoint cadence (seconds apart), so the
+                    # bounded stall is rare and sized by the journal's
+                    # incomplete tail, not its full history.
+                    os.fsync(fh.fileno())  # noqa: PTA010 -- lock must cover the tmp->live swap; see comment above
             self._fh.close()
             os.replace(tmp, self.path)
             self._fh = open(self.path, "a")

@@ -457,10 +457,18 @@ def _express_step(
     cmax_new = jnp.maximum(
         jnp.maximum(finmax(u_u), finmax(w_u)), finmax(pc_route)
     )
+    # the min side MUST mask the unused arrival lanes (add_row == -1):
+    # _express_mini_inputs fills them with a synthetic zero pod the
+    # cost model still prices, so a model pricing that phantom below
+    # zero would fail domain_ok for EVERY batch — degrading every
+    # express window to the slow path on lanes no real pod occupies
+    arr_valid = add_row >= 0
     cmin_new = jnp.minimum(
-        jnp.min(u_u), jnp.minimum(jnp.min(w_u), jnp.min(
-            jnp.where(has_pref, pc_route, 0)
-        ))
+        jnp.min(jnp.where(arr_valid, u_u, 0)),
+        jnp.minimum(
+            jnp.min(jnp.where(arr_valid, w_u, 0)),
+            jnp.min(jnp.where(has_pref, pc_route, 0)),
+        ),
     )
     domain_ok = (cmin_new >= 0) & (
         2 * cmax_new.astype(jnp.int64) * scale.astype(jnp.int64)

@@ -1207,3 +1207,83 @@ class TestCli:
         doc = json.loads(proc.stdout)
         assert doc["count"] == 1
         assert doc["violations"][0]["code"] == "PTA001"
+
+
+class TestRuleFilter:
+    """Satellite: ``--rule PTA0NN[,PTA0MM]`` isolates one analysis —
+    the CI lanes run PTA010 and PTA008,PTA009 in isolation, and
+    bisecting a red full run needs per-rule reruns."""
+
+    DIRTY = {
+        # one PTA001 (hot-path sync) + one PTA003 (inline jit)
+        "poseidon_tpu/ops/resident.py": """\
+            def f(x):
+                return x.item()
+        """,
+        "poseidon_tpu/misc.py": """\
+            import jax
+
+            def g(model, x):
+                return jax.jit(model)(x)
+        """,
+    }
+
+    def run_cli(self, tmp_path, files, *extra):
+        paths = []
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+            paths.append(str(p))
+        proc = subprocess.run(
+            [sys.executable, "-m", "poseidon_tpu.analysis",
+             "--format=json", "--root", str(tmp_path), *extra, *paths],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        return proc, json.loads(proc.stdout) if proc.stdout else None
+
+    def test_single_rule_filters_other_findings(self, tmp_path):
+        proc, doc = self.run_cli(tmp_path, self.DIRTY)
+        assert proc.returncode == 1
+        assert sorted(v["code"] for v in doc["violations"]) == \
+            ["PTA001", "PTA003"]
+        proc, doc = self.run_cli(
+            tmp_path, self.DIRTY, "--rule", "PTA001"
+        )
+        assert proc.returncode == 1
+        assert [v["code"] for v in doc["violations"]] == ["PTA001"]
+
+    def test_comma_list_selects_both(self, tmp_path):
+        proc, doc = self.run_cli(
+            tmp_path, self.DIRTY, "--rule", "PTA001,PTA003"
+        )
+        assert proc.returncode == 1
+        assert sorted(v["code"] for v in doc["violations"]) == \
+            ["PTA001", "PTA003"]
+
+    def test_selected_rule_clean_exits_zero(self, tmp_path):
+        proc, doc = self.run_cli(
+            tmp_path, self.DIRTY, "--rule", "PTA010"
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert doc["violations"] == []
+
+    def test_unknown_rule_exits_two(self, tmp_path):
+        proc, _ = self.run_cli(
+            tmp_path, self.DIRTY, "--rule", "PTA099"
+        )
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "unknown rule id" in proc.stderr
+        assert "PTA099" in proc.stderr
+
+    def test_no_python_targets_exits_two(self, tmp_path):
+        sub = tmp_path / "poseidon_tpu" / "empty"
+        sub.mkdir(parents=True)
+        (sub / "notes.md").write_text("no code here\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "poseidon_tpu.analysis",
+             "--root", str(tmp_path), str(sub)],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "no Python targets" in proc.stderr

@@ -1,0 +1,100 @@
+"""Golden fixture corpus driver (tests/fixtures/analysis/).
+
+One parametrized test per rule code: the known-bad fixture must fire
+the rule, the known-good must not. The parametrization enumerates
+EVERY rule code, so adding PTA011 without adding its fixtures fails
+here — the corpus is how a new rule proves both halves of its
+contract (it catches the bug, and the idiomatic fix is clean).
+
+Fixture sources are stored as ``*.py.txt`` (stripped to ``.py`` when
+copied into the temp tree) so the deliberately-bad code never enters
+the analyzer's own shipped-tree clean run; see the corpus README.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import pathlib
+import shutil
+
+import pytest
+
+from poseidon_tpu.analysis import analyze_tree
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+
+ALL_RULES = tuple(f"PTA{n:03d}" for n in range(11))
+JAXPR_RULES = ("PTA008", "PTA009")
+
+
+def _materialize(side: pathlib.Path, dst: pathlib.Path) -> list:
+    """Copy a fixture mini-tree, stripping the .txt armor."""
+    paths = []
+    for src in sorted(side.rglob("*")):
+        if src.is_dir():
+            continue
+        rel = src.relative_to(side).as_posix()
+        if rel.endswith(".py.txt"):
+            rel = rel[: -len(".txt")]
+        out = dst / rel
+        out.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(src, out)
+        if rel.endswith(".py"):
+            paths.append(out)
+    return paths
+
+
+def _load_fixture_module(code: str):
+    path = FIXTURES / code / "fixture.py.txt"
+    loader = importlib.machinery.SourceFileLoader(
+        f"_corpus_{code.lower()}", str(path)
+    )
+    spec = importlib.util.spec_from_loader(loader.name, loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+def _jaxpr_fires(code: str, mod, which: str) -> bool:
+    import jax
+
+    fn = getattr(mod, which)
+    args = mod.example_args()[which]
+    closed = jax.make_jaxpr(fn)(*args)
+    if code == "PTA008":
+        from poseidon_tpu.analysis.jaxpr_check import structural_problems
+
+        return bool(structural_problems("fixture", closed))
+    from poseidon_tpu.analysis.padding_taint import analyze_kernel
+
+    return bool(analyze_kernel("fixture", closed))
+
+
+@pytest.mark.parametrize("code", ALL_RULES)
+def test_fixture_pair(code, tmp_path):
+    root = FIXTURES / code
+    assert root.is_dir(), (
+        f"no fixture corpus for {code}: adding a rule requires adding "
+        f"its bad/good pair under {root}"
+    )
+    if code in JAXPR_RULES:
+        mod = _load_fixture_module(code)
+        assert _jaxpr_fires(code, mod, "bad"), (
+            f"{code} bad fixture did not fire"
+        )
+        assert not _jaxpr_fires(code, mod, "good"), (
+            f"{code} good fixture fired"
+        )
+        return
+    for side, expect in (("bad", True), ("good", False)):
+        dst = tmp_path / side
+        paths = _materialize(root / side, dst)
+        assert paths, f"{code}/{side} has no Python fixtures"
+        violations, _ = analyze_tree(dst, paths)
+        fired = any(v.code == code for v in violations)
+        assert fired == expect, (
+            f"{code}/{side}: expected fired={expect}, got "
+            + "; ".join(f"{v.code} {v.path}:{v.line}" for v in violations)
+        )

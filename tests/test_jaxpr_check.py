@@ -75,6 +75,73 @@ class TestProductionAudit:
         assert vs[0].code == "PTA008"
 
 
+class TestPaddingAuditProduction:
+    """PTA009 over the real production trace set (same traces, no
+    second bootstrap round)."""
+
+    def test_production_kernels_padding_clean(self, traces):
+        from poseidon_tpu.analysis.padding_taint import (
+            run_padding_audit,
+        )
+
+        violations, audited = run_padding_audit(REPO, traces=traces)
+        assert audited == len(EXPECTED_KERNELS)
+        assert violations == [], "\n".join(
+            v.message for v in violations
+        )
+
+    def test_stale_sanction_reported(self, traces):
+        """An entry no current trace exercises is itself a violation —
+        the PTA006 handoff discipline applied to mask sanctions."""
+        import dataclasses
+
+        from poseidon_tpu.analysis.contracts import DEFAULT_CONTRACTS
+        from poseidon_tpu.analysis.padding_taint import (
+            run_padding_audit,
+        )
+
+        kmc = dict(DEFAULT_CONTRACTS.kernel_mask_contracts)
+        kmc["*"] = kmc["*"] + (
+            ("reduce_min", "_no_such_function", "bogus"),
+        )
+        contracts = dataclasses.replace(
+            DEFAULT_CONTRACTS, kernel_mask_contracts=kmc
+        )
+        vs, _ = run_padding_audit(
+            REPO, traces=traces, contracts=contracts
+        )
+        assert len(vs) == 1
+        assert vs[0].code == "PTA009"
+        assert "stale" in vs[0].message
+        assert "_no_such_function" in vs[0].message
+
+    def test_every_sanction_entry_is_load_bearing(self, traces):
+        """Dropping ANY kernel_mask_contracts entry makes the audit
+        fire on the shipped traces — the sanction list holds no dead
+        weight (mirrors PTA006's handoff acceptance)."""
+        import dataclasses
+
+        from poseidon_tpu.analysis.contracts import DEFAULT_CONTRACTS
+        from poseidon_tpu.analysis.padding_taint import (
+            run_padding_audit,
+        )
+
+        entries = DEFAULT_CONTRACTS.kernel_mask_contracts["*"]
+        assert len(entries) >= 8
+        for i, dropped in enumerate(entries):
+            kmc = {"*": entries[:i] + entries[i + 1:]}
+            contracts = dataclasses.replace(
+                DEFAULT_CONTRACTS, kernel_mask_contracts=kmc
+            )
+            vs, _ = run_padding_audit(
+                REPO, traces=traces, contracts=contracts
+            )
+            assert any(
+                v.code == "PTA009" and dropped[1] in v.message
+                for v in vs
+            ), f"dropping sanction {dropped[:2]} went undetected"
+
+
 def _tiny_instance(Tp=16, Mp=16):
     return DenseInstance(
         c=np.full((Tp, Mp), 3, np.int32),

@@ -1,0 +1,234 @@
+"""Tests for the padding-taint dataflow audit (analysis/padding_taint).
+
+Unit coverage drives ``analyze_kernel`` over tiny hand-rolled traces
+(fold-dominance, the bool-counting exemption, taint through scan
+carries); the acceptance tests re-introduce the REAL bug the pass
+exists to catch — reverting ``_express_step``'s arrival-lane mask
+(PR 10's express cost regression, re-fixed this wave) must produce
+unmasked tainted reduce_min candidates, and the shipped kernel must
+not.
+
+ISSUE naming note: the express lane's reductions live in
+``_express_step`` (the shared step body ``_express_chain`` jits and
+``_stream_chain`` scans); ``_express_patch`` is the price-patch
+scatter and contains no reductions — "the express kernel path" means
+the step body.
+"""
+
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu.analysis.padding_taint import analyze_kernel
+from poseidon_tpu.compat import enable_x64
+from poseidon_tpu.ops import resident as real_resident
+from poseidon_tpu.ops.dense_auction import DenseInstance
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# the two masked folds PR 10 added (and this wave's audit now proves
+# load-bearing): reverting them re-creates the shipped bug
+_MASKED_U = "jnp.min(jnp.where(arr_valid, u_u, 0)),"
+_MASKED_W = "jnp.min(jnp.where(arr_valid, w_u, 0)),"
+
+
+def _candidates(fn, *args):
+    with enable_x64(True):
+        closed = jax.make_jaxpr(fn)(*args)
+    return [
+        (c.primitive, c.function)
+        for c in analyze_kernel("unit", closed)
+    ]
+
+
+class TestFoldDominance:
+    def test_unmasked_fold_over_input_fires(self):
+        x = np.zeros(8, np.int32)
+        cands = _candidates(lambda x: jnp.min(x), x)
+        assert any(p == "reduce_min" for p, _ in cands), cands
+
+    def test_mask_at_the_fold_is_clean(self):
+        x = np.zeros(8, np.int32)
+        assert _candidates(
+            lambda x: jnp.min(jnp.where(x >= 0, x, 0)), x
+        ) == []
+
+    def test_upstream_mask_does_not_dominate(self):
+        """The laundering shape that shipped the real bug: a where on
+        the way in, arithmetic after it, an unmasked fold at the end.
+        The mask no longer dominates once the add re-mixes lanes."""
+        x = np.zeros(8, np.int32)
+
+        def laundered(x):
+            y = jnp.where(x >= 0, x, 0)  # masked ... for now
+            return jnp.min(y + 1)        # add kills domination
+
+        assert _candidates(laundered, x) != []
+
+    def test_scalar_inputs_are_clean(self):
+        assert _candidates(
+            lambda n: jnp.minimum(n, 0) * 2, np.int32(3)
+        ) == []
+
+    def test_bool_counting_fold_exempt(self):
+        """jnp.sum over a mask is how padding predicates are BUILT —
+        counting a tainted bool is not a finding, even through the
+        dtype conversion sum inserts."""
+        x = np.zeros(8, np.int32)
+        assert _candidates(
+            lambda x: jnp.sum(x >= 0, dtype=jnp.int32), x
+        ) == []
+
+    def test_reduce_and_over_tainted_mask_fires(self):
+        """...but an unmasked jnp.all IS a finding: a padded row
+        poisons a convergence certificate through exactly this."""
+        x = np.zeros(8, np.int32)
+        cands = _candidates(lambda x: jnp.all(x >= 0), x)
+        assert any(p == "reduce_and" for p, _ in cands), cands
+
+    def test_taint_flows_through_scan_carry(self):
+        x = np.zeros((4, 8), np.int32)
+
+        def scanned(x):
+            def step(carry, row):
+                return carry + row, jnp.min(carry)
+
+            init = jnp.zeros(8, jnp.int32)
+            _, outs = jax.lax.scan(step, init, x)
+            return outs
+
+        cands = _candidates(scanned, x)
+        assert any(p == "reduce_min" for p, _ in cands), cands
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the reverted real bug
+# ---------------------------------------------------------------------------
+
+
+def _load_reverted_resident(tmp_path):
+    """Load ops/resident.py with the arrival-lane masks stripped, as a
+    uniquely-named module (its own DenseTopology pytree registration
+    does not collide with the real one)."""
+    src = (REPO / "poseidon_tpu/ops/resident.py").read_text()
+    assert _MASKED_U in src and _MASKED_W in src, (
+        "acceptance anchor moved: update _MASKED_U/_MASKED_W"
+    )
+    bad = src.replace(_MASKED_U, "jnp.min(u_u),").replace(
+        _MASKED_W, "jnp.min(w_u),"
+    )
+    p = tmp_path / "resident_reverted.py"
+    p.write_text(bad)
+    loader = importlib.machinery.SourceFileLoader(
+        "_pta009_reverted_resident", str(p)
+    )
+    spec = importlib.util.spec_from_loader(loader.name, loader)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass decorators resolve cls.__module__ through sys.modules
+    sys.modules[loader.name] = mod
+    try:
+        loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(loader.name, None)
+        raise
+    return mod
+
+
+def _trace_express_step(mod):
+    """Trace ``mod._express_step`` on tiny hand-rolled shapes with a
+    LAUNDERING cost model — a where-mask at the model's output, the
+    wrong site, exactly the shape that hid the original bug from a
+    global-kill analysis."""
+    Tp = Mp = 16
+    kmax, pk, smax = 4, 2, 4
+    dev = DenseInstance(
+        c=np.full((Tp, Mp), 3, np.int32),
+        u=np.full(Tp, 9, np.int32),
+        w=np.full(Tp, 2, np.int32),
+        dgen=np.ones(Mp, np.int32),
+        s=np.ones(Mp, np.int32),
+        task_valid=np.ones(Tp, bool),
+        scale=np.int32(Tp + 1),
+        cmax=np.int32(64),
+        smax=smax,
+    )
+    neg1_t = np.full(Tp, -1, np.int32)
+    dt = mod.DenseTopology(
+        arc_unsched=neg1_t, arc_cluster=neg1_t, arc_u2s=neg1_t,
+        arc_pref=np.full((Tp, pk), -1, np.int32),
+        pref_machine=np.full((Tp, pk), -1, np.int32),
+        pref_rack=np.full((Tp, pk), -1, np.int32),
+        arc_c2m=np.full(Mp, -1, np.int32),
+        arc_r2m=np.full(Mp, -1, np.int32),
+        arc_m2s=np.full(Mp, -1, np.int32),
+        rack_of=np.full(Mp, -1, np.int32),
+        slots=np.ones(Mp, np.int32),
+        n_tasks=np.int32(8),
+    )
+    cost_dev = np.zeros(64, np.int64)
+    mini = np.zeros(3 * kmax + kmax * pk, np.int64)
+    add_row = np.full(kmax, -1, np.int32)
+    add_row[0] = Tp - 1
+    add_pm = np.full((kmax, pk), -1, np.int32)
+    add_pr = np.full((kmax, pk), -1, np.int32)
+    zeros_t = np.zeros(Tp, np.int32)
+    zeros_m = np.zeros(Mp, np.int32)
+    model_fn = lambda mi: jnp.where(mi >= 0, mi, 0)  # noqa: E731
+    with enable_x64(True):
+        return jax.make_jaxpr(
+            lambda dev, dt, cost, mini, a, l, f, ar, pm, pr:
+            mod._express_step(
+                dev, dt, cost, mini, a, l, f, ar, pm, pr,
+                model_fn=model_fn, kmax=kmax, pk=pk, alpha=16,
+                max_rounds=8, smax=smax, change_cap=4,
+            )
+        )(dev, dt, cost_dev, mini, zeros_t, zeros_t, zeros_m,
+          add_row, add_pm, add_pr)
+
+
+def _express_step_hits(closed):
+    return [
+        (c.primitive, c.function)
+        for c in analyze_kernel("express", closed)
+        if c.function == "_express_step"
+    ]
+
+
+class TestExpressAcceptance:
+    def test_reverted_arrival_mask_fires(self, tmp_path):
+        """Stripping PR 10's arrival-lane masks from the real
+        _express_step source re-creates the shipped bug, and PTA009
+        sees it: two unmasked tainted reduce_min folds."""
+        mod = _load_reverted_resident(tmp_path)
+        hits = _express_step_hits(_trace_express_step(mod))
+        assert hits.count(("reduce_min", "_express_step")) == 2, hits
+
+    def test_shipped_express_step_is_clean(self):
+        """The same trace of the REAL module: the masks dominate, no
+        _express_step candidate survives (the remaining candidates are
+        the sanctioned solve-family folds)."""
+        assert _express_step_hits(
+            _trace_express_step(real_resident)
+        ) == []
+
+    def test_sanctioned_solve_family_sites_still_seen(self):
+        """The sanctioned sites are FOUND by analyze_kernel (they are
+        real tainted folds — safety is by table construction); the
+        sanction list is what keeps them out of the violation stream.
+        Guards against the pass silently going blind."""
+        cands = [
+            (c.primitive, c.function)
+            for c in analyze_kernel(
+                "express", _trace_express_step(real_resident)
+            )
+        ]
+        assert ("reduce_min", "_task_options") in cands, cands
+        assert ("reduce_sum", "_solve") in cands, cands
